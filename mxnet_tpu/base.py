@@ -1,0 +1,99 @@
+"""Foundation utilities for the TPU-native framework.
+
+Capability-equivalent to the reference's dmlc-core base layer
+(reference: 3rdparty dmlc-core — CHECK/LOG macros, dmlc::GetEnv,
+dmlc::Parameter) and python/mxnet/base.py, rebuilt for a JAX/XLA stack:
+no ctypes handle plumbing is needed because ops dispatch straight into
+XLA through the in-process registry (see mxnet_tpu/ops/registry.py).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "check_call",
+    "get_env",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "mx_real_t",
+    "mx_uint",
+    "classproperty",
+    "data_dir",
+]
+
+logging.basicConfig(level=logging.WARNING)
+_LOGGER = logging.getLogger("mxnet_tpu")
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: python/mxnet/base.py:MXNetError)."""
+
+
+def check_call(ret):
+    """Compatibility shim: the reference checks C-API return codes
+    (python/mxnet/base.py:check_call). Here errors are Python exceptions,
+    so this only validates pseudo status codes from native extensions."""
+    if ret != 0:
+        raise MXNetError("native call failed with status %d" % ret)
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+# Default real type (reference: mx_real_t = np.float32).
+mx_real_t = np.float32
+mx_uint = int
+
+
+_ENV_PREFIXES = ("MXNET_", "MXTPU_")
+_ENV_REGISTRY: dict[str, Any] = {}
+
+
+def get_env(name: str, default: Any = None, typ: type | None = None):
+    """Environment-variable config knob (reference: dmlc::GetEnv; knobs
+    catalogued in docs/faq/env_var.md). Accepts both the reference's
+    ``MXNET_*`` names and native ``MXTPU_*`` names, MXTPU_* winning."""
+    raw = None
+    # Direct lookup first, then prefix-swapped alias.
+    if name in os.environ:
+        raw = os.environ[name]
+    else:
+        for p in _ENV_PREFIXES:
+            if name.startswith(p):
+                stem = name[len(p):]
+                for q in _ENV_PREFIXES:
+                    alias = q + stem
+                    if alias in os.environ:
+                        raw = os.environ[alias]
+                        break
+        if raw is None:
+            _ENV_REGISTRY.setdefault(name, default)
+            return default
+    _ENV_REGISTRY[name] = raw
+    if typ is None:
+        typ = type(default) if default is not None else str
+    if typ is bool:
+        return raw not in ("0", "false", "False", "")
+    return typ(raw)
+
+
+class classproperty:
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)
+
+
+def data_dir() -> str:
+    """Default data cache directory (reference: python/mxnet/base.py:data_dir)."""
+    return os.environ.get(
+        "MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet_tpu")
+    )

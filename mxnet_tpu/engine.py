@@ -1,0 +1,105 @@
+"""Execution engine facade.
+
+Reference: src/engine/ — the dependency scheduler (ThreadedEnginePerDevice,
+versioned vars, per-device worker pools, bulking; include/mxnet/engine.h).
+
+TPU-native rebuild: XLA/PJRT *is* the async engine. Every op dispatched
+through the registry returns immediately with a future-backed jax.Array;
+PJRT orders executions per device stream and overlaps host→device copies,
+which is exactly what ThreadedEnginePerDevice's worker pools + stream
+manager did for CUDA. What remains for the framework layer:
+
+- read-after-write ordering on *mutable* NDArrays: an NDArray mutation
+  installs a fresh jax.Array and bumps a version counter
+  (ndarray.py:NDArray._set_data), so any earlier reader keeps its
+  immutable snapshot — a lock-free re-expression of
+  ThreadedVar::AppendWriteDependency (src/engine/threaded_engine.h:115-220).
+- blocking waits: WaitForVar/WaitForAll map to jax block_until_ready.
+- a serial debug oracle: MXNET_ENGINE_TYPE=NaiveEngine makes every op
+  synchronous (reference: src/engine/naive_engine.cc), which turns async
+  XLA failures into synchronous Python tracebacks at the faulting op.
+- bulking knobs are honored at the CachedOp/Executor seam, where whole
+  graphs become one XLA executable (reference bulking:
+  src/engine/threaded_engine.h:470-508).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from .base import get_env
+
+__all__ = [
+    "is_naive",
+    "set_engine_type",
+    "wait_for_all",
+    "wait_for_var",
+    "bulk",
+    "on_complete",
+]
+
+_state = threading.local()
+
+
+def _naive_default():
+    return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
+
+
+_naive = _naive_default()
+
+
+def is_naive() -> bool:
+    return _naive
+
+
+def set_engine_type(name: str):
+    """Select 'NaiveEngine' (synchronous, debugging oracle) or any of the
+    reference's threaded engine names (all map to XLA async dispatch)."""
+    global _naive
+    _naive = name == "NaiveEngine"
+
+
+def maybe_sync(arrays):
+    """Called by the dispatcher after each op when in naive mode."""
+    if _naive:
+        for a in arrays:
+            a.block_until_ready()
+
+
+def wait_for_var(array):
+    """Engine::WaitForVar — block until `array`'s pending writes land."""
+    array.block_until_ready()
+
+
+def wait_for_all():
+    """Engine::WaitForAll (include/mxnet/engine.h:233)."""
+    import jax
+
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    # Barrier on every live device by synchronizing a trivial transfer.
+    for d in jax.devices():
+        try:
+            jax.device_put(0, d).block_until_ready()
+        except Exception:
+            pass
+
+
+@contextlib.contextmanager
+def bulk(size: int = 0):
+    """Engine bulking scope (reference: mx.engine.bulk /
+    MXNET_EXEC_BULK_EXEC_TRAIN). Under XLA the equivalent of executing a
+    bulk of ops as one engine job is compiling them into one executable;
+    that happens at the CachedOp seam, so this scope is advisory."""
+    yield
+
+
+def on_complete(callback):
+    """Run `callback` on a host thread once all currently dispatched work
+    completes (reference: Engine::PushAsync host callbacks)."""
+    t = threading.Thread(target=lambda: (wait_for_all(), callback()))
+    t.daemon = True
+    t.start()
+    return t

@@ -1,0 +1,711 @@
+"""NDArray — the mutable, asynchronously-evaluated n-dim array.
+
+Reference: include/mxnet/ndarray.h:82 (`NDArray` over a shared Chunk with
+a storage handle + engine variable), python/mxnet/ndarray/ndarray.py.
+
+TPU-native design: an NDArray owns an immutable ``jax.Array`` living in
+HBM (or host memory for cpu ctx). JAX dispatch is already asynchronous —
+calling an op returns a future-backed array immediately, and PJRT orders
+execution per device, which subsumes the reference's dependency engine
+for the read side (see mxnet_tpu/engine.py). Mutation — the part XLA
+does not give us — is modeled as *buffer replacement*: every write
+installs a fresh jax.Array and bumps ``version`` (the engine-var version
+counter of src/engine/threaded_engine.h:96). Readers that started before
+a write keep their snapshot, giving the same read/write ordering the
+threaded engine enforced with versioned vars, without locks. Under
+`jit`-compiled training steps, XLA input/output aliasing (donation)
+recovers in-place update performance (reference: static_alloc CachedOp).
+
+`wait_to_read`/`wait_to_write` map to ``block_until_ready`` (reference:
+ndarray.h:315-323 → Engine::WaitForVar).
+"""
+from __future__ import annotations
+
+import functools
+import numbers
+
+import numpy as np
+
+from ..base import mx_real_t
+from ..context import Context, current_context
+from .. import engine
+from ..ops import registry as _reg
+
+__all__ = [
+    "NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+    "eye", "concat", "stack", "moveaxis", "waitall", "imports_jnp",
+    "from_jax", "linspace", "split",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _dtype_np(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+class NDArray:
+    """An n-dimensional array on a device (reference: mx.nd.NDArray)."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_ag_node",
+                 "_ag_out_index", "version", "__weakref__")
+
+    # Make numpy defer binary ops (np_array + ndarray) to NDArray.
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None):
+        self._data = data
+        self._ctx = ctx if ctx is not None else current_context()
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_node = None
+        self._ag_out_index = 0
+        self.version = 0
+
+    # -- engine-var semantics -------------------------------------------------
+
+    def _set_data(self, new_data):
+        """Install a new buffer — the write side of the versioned engine var."""
+        self._data = new_data
+        self.version += 1
+        if engine.is_naive():
+            new_data.block_until_ready()
+        return self
+
+    @property
+    def data_(self):
+        return self._data
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # -- basic properties -----------------------------------------------------
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(str(self._data.dtype)) if hasattr(self._data, "dtype") else None
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # -- host transfer --------------------------------------------------------
+
+    def asnumpy(self):
+        """Blocking device→host copy (reference: ndarray.py:1951 →
+        MXNDArraySyncCopyToCPU → WaitToRead)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception:  # tracer-backed during hybridize
+            body = "<traced %s %s>" % (self.shape, self.dtype)
+        return "\n%s\n<NDArray %s @%s>" % (body, "x".join(map(str, self.shape)), self._ctx)
+
+    # -- copies / context movement -------------------------------------------
+
+    def copyto(self, other):
+        """Cross-device copy (reference: CopyFromTo src/ndarray/ndarray.cc:999).
+        Device→device moves ride ICI/PCIe via jax.device_put."""
+        import jax
+
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self._data, other._ctx.jax_device))
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device), ctx=other)
+        raise TypeError("copyto expects NDArray or Context")
+
+    def copy(self):
+        return self.copyto(self._ctx)
+
+    def as_in_context(self, ctx):
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    def as_in_ctx(self, ctx):
+        return self.as_in_context(ctx)
+
+    def astype(self, dtype, copy=True):
+        nd = _dtype_np(dtype)
+        if not copy and self.dtype == nd:
+            return self
+        return _invoke("cast", [self], dtype=str(nd))
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    # -- autograd -------------------------------------------------------------
+
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate gradient buffer and mark as autograd leaf
+        (reference: python/mxnet/ndarray/ndarray.py attach_grad →
+        MXAutogradMarkVariables)."""
+        from .. import autograd
+
+        autograd.mark_variables([self], [zeros_like(self)], grad_req=grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops ------------------------------------------------------------
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        return _invoke("reshape", [self], shape=tuple(shape))
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return _invoke("transpose", [self], axes=tuple(axes) if axes else None)
+
+    def flatten(self):
+        return _invoke("flatten", [self])
+
+    def squeeze(self, axis=None):
+        return _invoke("squeeze", [self], axis=axis)
+
+    def expand_dims(self, axis):
+        return _invoke("expand_dims", [self], axis=axis)
+
+    def broadcast_to(self, shape):
+        return _invoke("broadcast_to", [self], shape=tuple(shape))
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke("swapaxes", [self], dim1=dim1, dim2=dim2)
+
+    def split(self, num_outputs, axis=0):
+        return _invoke("split", [self], num_outputs=num_outputs, axis=axis)
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke("slice_axis", [self], axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke("take", [self, indices], axis=axis, mode=mode)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return _invoke("one_hot", [self], depth=depth, on_value=on_value,
+                       off_value=off_value)
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return _invoke("pick", [self, index], axis=axis, keepdims=keepdims)
+
+    def tile(self, reps):
+        return _invoke("tile", [self], reps=tuple(reps))
+
+    def repeat(self, repeats, axis=None):
+        return _invoke("repeat", [self], repeats=repeats, axis=axis)
+
+    def pad(self, mode="constant", pad_width=None, constant_value=0):
+        return _invoke("pad", [self], mode=mode, pad_width=tuple(pad_width),
+                       constant_value=constant_value)
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke("clip", [self], a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return _invoke("abs", [self])
+
+    def sign(self):
+        return _invoke("sign", [self])
+
+    def round(self):
+        return _invoke("round", [self])
+
+    def sqrt(self):
+        return _invoke("sqrt", [self])
+
+    def square(self):
+        return _invoke("square", [self])
+
+    def exp(self):
+        return _invoke("exp", [self])
+
+    def log(self):
+        return _invoke("log", [self])
+
+    def sigmoid(self):
+        return _invoke("sigmoid", [self])
+
+    def relu(self):
+        return _invoke("relu", [self])
+
+    def tanh(self):
+        return _invoke("tanh", [self])
+
+    def softmax(self, axis=-1):
+        return _invoke("softmax", [self], axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return _invoke("log_softmax", [self], axis=axis)
+
+    # -- reductions -----------------------------------------------------------
+
+    def sum(self, axis=None, keepdims=False):
+        return _invoke("sum", [self], axis=_norm_axis(axis), keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return _invoke("mean", [self], axis=_norm_axis(axis), keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return _invoke("max", [self], axis=_norm_axis(axis), keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return _invoke("min", [self], axis=_norm_axis(axis), keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return _invoke("prod", [self], axis=_norm_axis(axis), keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _invoke("norm", [self], ord=ord, axis=_norm_axis(axis),
+                       keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke("argmax", [self], axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke("argmin", [self], axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _invoke("argsort", [self], axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _invoke("topk", [self], axis=axis, k=k, ret_typ=ret_typ,
+                       is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _invoke("sort", [self], axis=axis, is_ascend=is_ascend)
+
+    def dot(self, other):
+        return _invoke("dot", [self, other])
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+
+        return _sp.cast_storage(self, stype)
+
+    # -- arithmetic -----------------------------------------------------------
+
+    def __add__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __iadd__(self, other):
+        res = self.__add__(other)
+        return self._set_data(res._data)
+
+    def __sub__(self, other):
+        return _binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _binary_r("broadcast_sub", "_rminus_scalar", self, other)
+
+    def __isub__(self, other):
+        res = self.__sub__(other)
+        return self._set_data(res._data)
+
+    def __mul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __imul__(self, other):
+        res = self.__mul__(other)
+        return self._set_data(res._data)
+
+    def __truediv__(self, other):
+        return _binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _binary_r("broadcast_div", "_rdiv_scalar", self, other)
+
+    def __itruediv__(self, other):
+        res = self.__truediv__(other)
+        return self._set_data(res._data)
+
+    def __mod__(self, other):
+        return _binary("broadcast_mod", "_mod_scalar", self, other)
+
+    def __rmod__(self, other):
+        return _binary_r("broadcast_mod", "_rmod_scalar", self, other)
+
+    def __pow__(self, other):
+        return _binary("broadcast_power", "_power_scalar", self, other)
+
+    def __rpow__(self, other):
+        return _binary_r("broadcast_power", "_rpower_scalar", self, other)
+
+    def __neg__(self):
+        return _invoke("negative", [self])
+
+    def __eq__(self, other):
+        return _binary("broadcast_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        return _binary("broadcast_not_equal", "_not_equal_scalar", self, other)
+
+    def __gt__(self, other):
+        return _binary("broadcast_greater", "_greater_scalar", self, other)
+
+    def __ge__(self, other):
+        return _binary("broadcast_greater_equal", "_greater_equal_scalar", self, other)
+
+    def __lt__(self, other):
+        return _binary("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _binary("broadcast_lesser_equal", "_lesser_equal_scalar", self, other)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- indexing -------------------------------------------------------------
+
+    def _convert_index(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(self._convert_index(k) for k in key)
+        if isinstance(key, list):
+            return np.array(key)
+        return key
+
+    def __getitem__(self, key):
+        key_c = self._convert_index(key)
+        from .. import autograd
+
+        if autograd.is_recording():
+            # Route through the slice op so the tape sees it.
+            return _invoke("_index", [self], key=_IndexWrap(key_c))
+        return NDArray(self._data[key_c], ctx=self._ctx)
+
+    def __setitem__(self, key, value):
+        key_c = self._convert_index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(value, (list, tuple, np.ndarray)):
+            value = np.asarray(value, dtype=self.dtype)
+        self._set_data(self._data.at[key_c].set(value))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- serialization helpers ------------------------------------------------
+
+    def tobytes(self):
+        return self.asnumpy().tobytes()
+
+
+class _IndexWrap:
+    """Hashable wrapper letting index expressions key the jit cache."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+    def _tokens(self, k):
+        if isinstance(k, tuple):
+            return ("tuple",) + tuple(self._tokens(x) for x in k)
+        if isinstance(k, slice):
+            return ("slice", k.start, k.stop, k.step)
+        if isinstance(k, np.ndarray):
+            return ("nparray", k.shape, str(k.dtype), k.tobytes())
+        if hasattr(k, "shape") and hasattr(k, "dtype"):  # jax array
+            return ("array", tuple(k.shape), str(k.dtype))
+        return ("lit", k)
+
+    def __hash__(self):
+        return hash(self._tokens(self.key))
+
+    def __eq__(self, other):
+        return isinstance(other, _IndexWrap) and \
+            self._tokens(self.key) == other._tokens(other.key)
+
+
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+def _wrap_outputs(raw, ctx, out=None):
+    multi = isinstance(raw, (tuple, list))
+    outs = list(raw) if multi else [raw]
+    if out is not None:
+        targets = out if isinstance(out, (tuple, list)) else [out]
+        for t, r in zip(targets, outs):
+            t._set_data(r)
+        return out
+    wrapped = [NDArray(r, ctx=ctx) for r in outs]
+    if engine.is_naive():
+        for w in wrapped:
+            w.wait_to_read()
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def _invoke(name, inputs, out=None, _named=None, **attrs):
+    """The imperative dispatch path (reference call stack §3.1:
+    mx.nd.op → MXImperativeInvokeEx → Imperative::Invoke →
+    Engine::PushAsync). Here: unwrap → maybe record on tape → run the
+    per-(op, attrs) jitted FCompute → wrap, all returning immediately
+    thanks to JAX async dispatch.
+
+    `_named`: names for trailing array-valued keyword inputs (e.g.
+    softmax's `length`), bound by keyword inside the compiled closure.
+    """
+    op = _reg.get(name)
+    if op.train_aware and "training" not in attrs:
+        # Reference semantics: ops like Dropout/BatchNorm key off the
+        # autograd train-mode state (imperative.h:150 thread-local flags).
+        from .. import autograd as _ag
+
+        attrs["training"] = _ag.is_training()
+    arrays = []
+    ctx = None
+    for x in inputs:
+        if isinstance(x, NDArray):
+            arrays.append(x._data)
+            if ctx is None:
+                ctx = x._ctx
+        else:
+            arrays.append(x)
+    ctx = ctx or current_context()
+    named = tuple(_named) if _named else ()
+
+    from .. import autograd
+
+    if autograd.is_recording() and op.differentiable and not _reg._is_traced(arrays):
+        raw = autograd._record_op(op, inputs, arrays, attrs, named)
+    else:
+        raw = _reg.invoke_raw(op, arrays, attrs, named)
+    result = _wrap_outputs(raw, ctx, out=out)
+    if autograd.is_recording() and op.differentiable and not _reg._is_traced(arrays):
+        autograd._attach_outputs(result)
+    return result
+
+
+def _binary(op_name, scalar_op_name, lhs, rhs):
+    if isinstance(rhs, NDArray):
+        return _invoke(op_name, [lhs, rhs])
+    if isinstance(rhs, numbers.Number):
+        return _invoke(scalar_op_name, [lhs], scalar=float(rhs))
+    if isinstance(rhs, np.ndarray):
+        return _invoke(op_name, [lhs, array(rhs, ctx=lhs.context)])
+    return NotImplemented
+
+
+def _binary_r(op_name, scalar_op_name, lhs, rhs):
+    if isinstance(rhs, numbers.Number):
+        return _invoke(scalar_op_name, [lhs], scalar=float(rhs))
+    if isinstance(rhs, np.ndarray):
+        return _invoke(op_name, [array(rhs, ctx=lhs.context), lhs])
+    return NotImplemented
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def _place(np_value, ctx):
+    import jax
+
+    ctx = ctx if ctx is not None else current_context()
+    return NDArray(jax.device_put(np_value, ctx.jax_device), ctx=ctx)
+
+
+def from_jax(jarr, ctx=None):
+    return NDArray(jarr, ctx=ctx)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference: mx.nd.array).
+    Always copies, like the reference — mutating the result never
+    touches the source."""
+    if isinstance(source_array, NDArray):
+        out = source_array.copyto(ctx if ctx is not None else source_array.context)
+        return out.astype(dtype) if dtype is not None else out
+    npv = np.asarray(source_array)
+    if dtype is None:
+        dtype = mx_real_t if npv.dtype == np.float64 else npv.dtype
+    return _place(npv.astype(_dtype_np(dtype)), ctx)
+
+
+def _device_fill(shape, dtype, ctx, val):
+    """Create filled buffers directly on the target device — no host
+    allocation or PCIe traffic (unlike the reference's cpu→gpu copy path;
+    XLA materializes the constant in HBM)."""
+    import jax.numpy as jnp
+
+    ctx = ctx if ctx is not None else current_context()
+    if isinstance(shape, numbers.Number):
+        shape = (shape,)
+    out = jnp.full(shape, val, dtype=dtype, device=ctx.jax_device)
+    return NDArray(out, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    # XLA buffers are always defined; empty == zeros without the
+    # reference's uninitialized-memory hazard.
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    dtype = _dtype_np(dtype) if dtype is not None else mx_real_t
+    return _device_fill(shape, dtype, ctx, 0)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    dtype = _dtype_np(dtype) if dtype is not None else mx_real_t
+    return _device_fill(shape, dtype, ctx, 1)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    dtype = _dtype_np(dtype) if dtype is not None else mx_real_t
+    return _device_fill(shape, dtype, ctx, val)
+
+
+def zeros_like(other, **kwargs):
+    return zeros(other.shape, ctx=other.context, dtype=other.dtype)
+
+
+def ones_like(other, **kwargs):
+    return ones(other.shape, ctx=other.context, dtype=other.dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    dtype = _dtype_np(dtype) if dtype is not None else mx_real_t
+    v = np.arange(start, stop, step, dtype=dtype)
+    if repeat != 1:
+        v = np.repeat(v, repeat)
+    return _place(v, ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    dtype = _dtype_np(dtype) if dtype is not None else mx_real_t
+    return _place(np.linspace(start, stop, num, endpoint=endpoint, dtype=dtype), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    dtype = _dtype_np(dtype) if dtype is not None else mx_real_t
+    return _place(np.eye(N, M if M else N, k, dtype=dtype), ctx)
+
+
+def concat(*arrays, dim=1):
+    return _invoke("concat", list(arrays), dim=dim)
+
+
+def stack(*arrays, axis=0):
+    return _invoke("stack", list(arrays), axis=axis)
+
+
+def split(ary, indices_or_sections, axis=0):
+    return _invoke("split", [ary], num_outputs=indices_or_sections, axis=axis)
+
+
+def moveaxis(tensor, source, destination):
+    return _invoke("moveaxis", [tensor], source=source, destination=destination)
+
+
+def waitall():
+    """Reference: mx.nd.waitall → Engine::WaitForAll."""
+    engine.wait_for_all()
+
+
+def imports_jnp():
+    return _jnp()

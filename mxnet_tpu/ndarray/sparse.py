@@ -1,0 +1,224 @@
+"""Sparse NDArray storage types.
+
+Reference: include/mxnet/ndarray.h:61-65 (kDefaultStorage, kRowSparseStorage,
+kCSRStorage), python/mxnet/ndarray/sparse.py (CSRNDArray, RowSparseNDArray),
+src/operator/tensor/cast_storage-inl.h.
+
+TPU rebuild: compressed representations are kept (indices/values,
+indptr/indices/data as real jax arrays) for memory-efficient embeddings
+and IO, while compute lowers to gather/scatter + segment ops or falls
+back to dense — the reference's own storage-fallback dispatch
+(op_attr_types.h kFComputeFallback) made the same trade on unsupported
+kernels. TPUs have no sparse ALU; scatter/gather rides the VPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray, array, zeros as _dense_zeros
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros", "retain"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base (reference: python/mxnet/ndarray/sparse.py:BaseSparseNDArray)."""
+
+    def __init__(self, data, ctx=None):
+        super().__init__(data, ctx=ctx)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse: (indices, values) where values[i] is row indices[i]
+    (reference: ndarray.h kRowSparseStorage — gradient format for
+    embeddings)."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        values = data if isinstance(data, NDArray) else array(data, ctx=ctx)
+        super().__init__(values._data, ctx=ctx or values.context)
+        self._indices = indices if isinstance(indices, NDArray) else \
+            array(indices, ctx=ctx, dtype="int64")
+        self._full_shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._full_shape
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def data(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    def todense(self):
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self._full_shape, dtype=self._data.dtype)
+        idx = self._indices._data.astype(jnp.int32)
+        out = out.at[idx].set(self._data)
+        return NDArray(out, ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError("cast row_sparse -> %s not supported" % stype)
+
+    def copyto(self, other):
+        return self.todense().copyto(other)
+
+    def __repr__(self):
+        return "\n<RowSparseNDArray %s @%s>" % (
+            "x".join(map(str, self._full_shape)), self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: kCSRStorage; used by
+    LibSVMIter and sparse linear models)."""
+
+    def __init__(self, data, indptr, indices, shape, ctx=None):
+        values = data if isinstance(data, NDArray) else array(data, ctx=ctx)
+        super().__init__(values._data, ctx=ctx or values.context)
+        self._indptr = indptr if isinstance(indptr, NDArray) else \
+            array(indptr, ctx=ctx, dtype="int64")
+        self._indices = indices if isinstance(indices, NDArray) else \
+            array(indices, ctx=ctx, dtype="int64")
+        self._full_shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._full_shape
+
+    @property
+    def indptr(self):
+        return self._indptr
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def data(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    def todense(self):
+        import jax.numpy as jnp
+
+        m, n = self._full_shape
+        indptr = self._indptr._data.astype(jnp.int32)
+        cols = self._indices._data.astype(jnp.int32)
+        nnz = cols.shape[0]
+        # row id per nnz element: searchsorted over indptr
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        out = jnp.zeros((m, n), dtype=self._data.dtype)
+        out = out.at[rows, cols].set(self._data)
+        return NDArray(out, ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError("cast csr -> %s not supported" % stype)
+
+    def copyto(self, other):
+        return self.todense().copyto(other)
+
+    def __repr__(self):
+        return "\n<CSRNDArray %s @%s>" % (
+            "x".join(map(str, self._full_shape)), self._ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (reference: sparse.py:row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(array(np.asarray(data, dtype=dtype or np.float32)),
+                                array(np.asarray(indices), dtype="int64"),
+                                shape, ctx=ctx)
+    dense = np.asarray(arg1, dtype=dtype or np.float32)
+    nz_rows = np.where(np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+    return RowSparseNDArray(array(dense[nz_rows]), array(nz_rows, dtype="int64"),
+                            dense.shape, ctx=ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (reference: sparse.py:csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(array(np.asarray(data, dtype=dtype or np.float32)),
+                          array(np.asarray(indptr), dtype="int64"),
+                          array(np.asarray(indices), dtype="int64"),
+                          shape, ctx=ctx)
+    dense = np.asarray(arg1, dtype=dtype or np.float32)
+    m, n = dense.shape
+    indptr = [0]
+    indices = []
+    data = []
+    for r in range(m):
+        nz = np.where(dense[r] != 0)[0]
+        indices.extend(nz.tolist())
+        data.extend(dense[r, nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(array(np.asarray(data, np.float32)),
+                      array(np.asarray(indptr), dtype="int64"),
+                      array(np.asarray(indices), dtype="int64"),
+                      (m, n), ctx=ctx)
+
+
+def cast_storage(arr, stype):
+    """Reference: src/operator/tensor/cast_storage-inl.h."""
+    if stype == "default":
+        return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "row_sparse":
+        if isinstance(arr, RowSparseNDArray):
+            return arr
+        return row_sparse_array(arr.asnumpy())
+    if stype == "csr":
+        if isinstance(arr, CSRNDArray):
+            return arr
+        return csr_matrix(arr.asnumpy())
+    raise ValueError("unknown stype %s" % stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "default":
+        return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            array(np.zeros((0,) + tuple(shape[1:]), dtype or np.float32)),
+            array(np.zeros((0,), np.int64), dtype="int64"), shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(
+            array(np.zeros((0,), dtype or np.float32)),
+            array(np.zeros((shape[0] + 1,), np.int64), dtype="int64"),
+            array(np.zeros((0,), np.int64), dtype="int64"), shape, ctx=ctx)
+    raise ValueError(stype)
+
+
+def retain(arr, indices):
+    """sparse_retain (reference: src/operator/tensor/sparse_retain-inl.h)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise TypeError("retain expects RowSparseNDArray")
+    keep = np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                      else indices, np.int64)
+    old_idx = arr.indices.asnumpy()
+    mask = np.isin(old_idx, keep)
+    new_idx = old_idx[mask]
+    vals = arr.data.asnumpy()[mask]
+    return RowSparseNDArray(array(vals), array(new_idx, dtype="int64"),
+                            arr.shape, ctx=arr.context)

@@ -1,0 +1,63 @@
+"""NDArray save/load.
+
+Reference: src/ndarray/ndarray.cc:1537-1745 (binary format with magic +
+names) and python/mxnet/ndarray/utils.py:149-222 (mx.nd.save/load).
+
+TPU rebuild: same user contract (list or dict of arrays round-trips,
+`.params` files interoperate across our Gluon/Module checkpoints). The
+container is .npz-based rather than the reference's private binary
+layout; arrays are gathered from device before write (SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import zipfile
+
+import numpy as np
+
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load", "save_dict", "load_dict"]
+
+_LIST_PREFIX = "__mxtpu_list__:"
+
+
+def save(fname, data):
+    """Save a list or dict of NDArrays (reference: mx.nd.save)."""
+    arrays = {}
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        for i, v in enumerate(data):
+            arrays["%s%d" % (_LIST_PREFIX, i)] = v.asnumpy()
+    elif isinstance(data, dict):
+        for k, v in data.items():
+            arrays[k] = v.asnumpy()
+    else:
+        raise TypeError("save expects NDArray, list or dict")
+    tmp = fname + ".tmp%d" % os.getpid()
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, fname)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save` (reference: mx.nd.load)."""
+    with np.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+            keys.sort(key=lambda k: int(k[len(_LIST_PREFIX):]))
+            return [array(z[k]) for k in keys]
+        return {k: array(z[k]) for k in keys}
+
+
+def save_dict(fname, data):
+    save(fname, dict(data))
+
+
+def load_dict(fname):
+    out = load(fname)
+    if not isinstance(out, dict):
+        raise ValueError("%s does not contain a dict" % fname)
+    return out

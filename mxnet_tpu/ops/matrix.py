@@ -1,0 +1,354 @@
+"""Shape-manipulation, indexing and linear-algebra-entry operators.
+
+Reference: src/operator/tensor/matrix_op.cc (reshape/transpose/slice/
+concat/...), indexing_op.cc (take/gather_nd/scatter_nd/one_hot),
+dot-inl.h (dot/batch_dot), diag_op.cc, depth/space ops.
+
+TPU rebuild: `dot`/`batch_dot` lower to XLA dot_general → MXU systolic
+array; everything else is metadata-only or gather/scatter HLO. MXNet's
+zero-copy view semantics (Slice/Reshape sharing a Chunk) become XLA
+bitcasts/fusions inside compiled regions — immaterial to the user API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _solve_reshape_spec(src, spec):
+    """Expand MXNet reshape special codes (matrix_op-inl.h): 0 copy dim,
+    -1 infer, -2 copy rest, -3 merge two dims, -4 split one dim."""
+    out = []
+    i = 0  # index into src
+    j = 0
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            cur = src[i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        elif s == -1:
+            out.append(-1); i += 1
+        else:
+            out.append(s); i += 1
+        j += 1
+    return out
+
+
+@register("reshape", aliases=("Reshape",))
+def _reshape(a, shape=(), reverse=False):
+    # reverse=True matches special codes right-to-left against the source
+    # shape (ReshapeParam.reverse); only the shape *computation* flips —
+    # the data stays in row-major order.
+    if reverse:
+        spec = list(reversed(list(shape)))
+        # A -4 split reads its two sub-dims after it; keep each
+        # (-4, d1, d2) triple in original internal order when reversing.
+        k = 0
+        while k + 2 < len(spec):
+            if spec[k + 2] == -4:
+                spec[k], spec[k + 1], spec[k + 2] = -4, spec[k], spec[k + 1]
+                k += 3
+            else:
+                k += 1
+        solved = _solve_reshape_spec(list(reversed(a.shape)), spec)
+        return a.reshape(tuple(reversed(solved)))
+    return a.reshape(tuple(_solve_reshape_spec(list(a.shape), list(shape))))
+
+
+@register("reshape_like")
+def _reshape_like(a, b):
+    return a.reshape(b.shape)
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(a):
+    return _jnp().array(a.shape, dtype=np.int64)
+
+
+@register("size_array", differentiable=False)
+def _size_array(a):
+    return _jnp().array([a.size], dtype=np.int64)
+
+
+@register("transpose")
+def _transpose(a, axes=None):
+    return _jnp().transpose(a, axes=axes if axes else None)
+
+
+@register("flatten", aliases=("Flatten",))
+def _flatten(a):
+    return a.reshape((a.shape[0], -1)) if a.ndim > 1 else a
+
+
+@register("squeeze")
+def _squeeze(a, axis=None):
+    return _jnp().squeeze(a, axis=axis)
+
+
+@register("expand_dims")
+def _expand_dims(a, axis=0):
+    return _jnp().expand_dims(a, axis)
+
+
+@register("broadcast_to")
+def _broadcast_to(a, shape=()):
+    tgt = tuple(d if s == 0 else s for s, d in zip(shape, a.shape)) \
+        if len(shape) == a.ndim else tuple(shape)
+    return _jnp().broadcast_to(a, tgt)
+
+
+@register("broadcast_like")
+def _broadcast_like(a, b):
+    return _jnp().broadcast_to(a, b.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(a, axis=(), size=()):
+    jnp = _jnp()
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(a.shape)
+    for ax, s in zip(axis, size):
+        tgt[ax] = s
+    return jnp.broadcast_to(a, tuple(tgt))
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(a, dim1=0, dim2=0):
+    return _jnp().swapaxes(a, dim1, dim2)
+
+
+@register("moveaxis")
+def _moveaxis(a, source=0, destination=0):
+    return _jnp().moveaxis(a, source, destination)
+
+
+@register("flip", aliases=("reverse",))
+def _flip(a, axis=0):
+    return _jnp().flip(a, axis=axis)
+
+
+@register("concat", aliases=("Concat",))
+def _concat(*arrays, dim=1, num_args=None):
+    return _jnp().concatenate(arrays, axis=dim)
+
+
+@register("stack")
+def _stack(*arrays, axis=0, num_args=None):
+    return _jnp().stack(arrays, axis=axis)
+
+
+@register("split", aliases=("SliceChannel", "slice_channel"))
+def _split(a, num_outputs=1, axis=1, squeeze_axis=False):
+    jnp = _jnp()
+    outs = jnp.split(a, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register("slice", aliases=("crop",))
+def _slice(a, begin=(), end=(), step=()):
+    sl = []
+    for i in range(len(begin)):
+        st = step[i] if step and i < len(step) and step[i] is not None else 1
+        sl.append(slice(begin[i], end[i], st))
+    return a[tuple(sl)]
+
+
+@register("slice_axis")
+def _slice_axis(a, axis=0, begin=0, end=None):
+    sl = [slice(None)] * a.ndim
+    sl[axis] = slice(begin, end)
+    return a[tuple(sl)]
+
+
+@register("slice_like")
+def _slice_like(a, b, axes=()):
+    sl = [slice(None)] * a.ndim
+    axes = axes if axes else range(min(a.ndim, b.ndim))
+    for ax in axes:
+        sl[ax] = slice(0, b.shape[ax])
+    return a[tuple(sl)]
+
+
+@register("_index")
+def _index(a, key=None):
+    return a[key.key]
+
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    jnp = _jnp()
+    idx = indices.astype(np.int32)
+    if mode == "wrap":
+        idx = idx % a.shape[axis]
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take")
+def _batch_take(a, indices):
+    jnp = _jnp()
+    idx = indices.astype(np.int32)
+    return a[jnp.arange(a.shape[0]), idx]
+
+
+@register("pick")
+def _pick(a, index, axis=-1, keepdims=False, mode="clip"):
+    jnp = _jnp()
+    idx = jnp.clip(index.astype(np.int32), 0, a.shape[axis] - 1)
+    idxe = jnp.expand_dims(idx, axis if axis >= 0 else a.ndim + axis)
+    out = jnp.take_along_axis(a, idxe, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd")
+def _gather_nd(a, indices):
+    idx = indices.astype(np.int32)
+    return a[tuple(idx[i] for i in range(idx.shape[0]))]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=()):
+    jnp = _jnp()
+    idx = indices.astype(np.int32)
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(idx.shape[0]))].set(data)
+
+
+@register("_scatter_nd_add")
+def _scatter_nd_add(data, indices, shape=()):
+    jnp = _jnp()
+    idx = indices.astype(np.int32)
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(idx.shape[0]))].add(data)
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    import jax.nn
+
+    oh = jax.nn.one_hot(indices.astype(np.int32), depth, dtype=np.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("tile")
+def _tile(a, reps=()):
+    return _jnp().tile(a, reps)
+
+
+@register("repeat")
+def _repeat(a, repeats=1, axis=None):
+    return _jnp().repeat(a, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def _pad(a, mode="constant", pad_width=(), constant_value=0.0):
+    jnp = _jnp()
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(a, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(a, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(a, pw, mode="reflect")
+    raise ValueError("unknown pad mode %s" % mode)
+
+
+@register("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    jnp = _jnp()
+    if transpose_a:
+        a = a.T if a.ndim == 2 else jnp.moveaxis(a, 0, -1)
+    if transpose_b:
+        b = b.T if b.ndim == 2 else jnp.moveaxis(b, -1, 0)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot contracts last axis of a with first axis of b.
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    jnp = _jnp()
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("diag")
+def _diag(a, k=0):
+    jnp = _jnp()
+    if a.ndim == 1:
+        return jnp.diag(a, k=k)
+    return jnp.diagonal(a, offset=k, axis1=-2, axis2=-1)
+
+
+@register("depth_to_space")
+def _depth_to_space(a, block_size=1):
+    jnp = _jnp()
+    n, c, h, w = a.shape
+    bs = block_size
+    x = a.reshape(n, bs, bs, c // (bs * bs), h, w)
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return x.reshape(n, c // (bs * bs), h * bs, w * bs)
+
+
+@register("space_to_depth")
+def _space_to_depth(a, block_size=1):
+    jnp = _jnp()
+    n, c, h, w = a.shape
+    bs = block_size
+    x = a.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+@register("ravel_multi_index", differentiable=False)
+def _ravel_multi_index(indices, shape=()):
+    jnp = _jnp()
+    idx = indices.astype(np.int64)
+    strides = np.array([int(np.prod(shape[i + 1:])) for i in range(len(shape))],
+                       dtype=np.int64)
+    return jnp.sum(idx * strides[:, None], axis=0).astype(np.float32)
+
+
+@register("unravel_index", differentiable=False)
+def _unravel_index(indices, shape=()):
+    jnp = _jnp()
+    outs = jnp.unravel_index(indices.astype(np.int64), shape)
+    return jnp.stack([o.astype(np.float32) for o in outs], axis=0)
+
+
+@register("zeros_like")
+def _zeros_like(a):
+    return _jnp().zeros_like(a)
+
+
+@register("ones_like")
+def _ones_like(a):
+    return _jnp().ones_like(a)

@@ -1,0 +1,194 @@
+"""Fused optimizer update operators.
+
+Reference: src/operator/optimizer_op.cc:43-651 (sgd/mp_sgd/sgd_mom/adam/
+rmsprop/ftrl/ftml/signsgd/signum + sparse variants).
+
+TPU rebuild: each update is one fused XLA kernel (jnp expressions fuse);
+multi-precision variants keep fp32 master weights while the model weight
+may be bf16/fp16 — same contract as mp_sgd_update. All return the new
+buffers; the caller commits them (donation under jitted train steps).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient):
+    jnp = _jnp()
+    g = grad * np.asarray(rescale_grad, grad.dtype)
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + np.asarray(wd, weight.dtype) * weight
+
+
+@register("sgd_update", differentiable=False)
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
+    return weight - np.asarray(lr, weight.dtype) * g
+
+
+@register("sgd_mom_update", differentiable=False)
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
+    new_mom = np.asarray(momentum, mom.dtype) * mom - np.asarray(lr, mom.dtype) * g
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", differentiable=False)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g32 = _apply_wd_rescale(weight32, grad.astype(weight32.dtype), wd,
+                            rescale_grad, clip_gradient)
+    new_w32 = weight32 - np.asarray(lr, weight32.dtype) * g32
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", differentiable=False)
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g32 = _apply_wd_rescale(weight32, grad.astype(weight32.dtype), wd,
+                            rescale_grad, clip_gradient)
+    new_mom = np.asarray(momentum, mom.dtype) * mom - np.asarray(lr, mom.dtype) * g32
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("nag_mom_update", differentiable=False)
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
+    new_mom = np.asarray(momentum, mom.dtype) * mom + g
+    return weight - np.asarray(lr, weight.dtype) * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", differentiable=False)
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    jnp = _jnp()
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * g * g
+    upd = np.asarray(lr, weight.dtype) * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return weight - upd, new_mean, new_var
+
+
+@register("rmsprop_update", differentiable=False)
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    jnp = _jnp()
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * g * g
+    new_w = weight - np.asarray(lr, weight.dtype) * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", differentiable=False)
+def _rmspropalex_update(weight, grad, n, g_buf, delta, lr=0.001, gamma1=0.9,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    jnp = _jnp()
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * g * g
+    new_g = gamma1 * g_buf + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - new_g * new_g + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", differentiable=False)
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = grad * np.asarray(rescale_grad, grad.dtype)
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + g * g
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+@register("ftml_update", differentiable=False)
+def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
+    jnp = _jnp()
+    g = grad * np.asarray(rescale_grad, grad.dtype) + wd * weight
+    if clip_grad is not None and clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * g * g
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+@register("signsgd_update", differentiable=False)
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    jnp = _jnp()
+    g = grad * np.asarray(rescale_grad, grad.dtype)
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", differentiable=False)
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    jnp = _jnp()
+    g = grad * np.asarray(rescale_grad, grad.dtype)
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+@register("adagrad_update", differentiable=False,
+          aliases=("_sparse_adagrad_update",))
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
+    new_hist = history + g * g
+    return weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist
+
+
+@register("adadelta_update", differentiable=False)
+def _adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
+    new_acc_g = rho * acc_g + (1 - rho) * g * g
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * delta * delta
+    return weight - delta, new_acc_g, new_acc_delta
+
+
+@register("multi_sum_sq", differentiable=False)
+def _multi_sum_sq(*arrays, num_arrays=0):
+    jnp = _jnp()
+    return jnp.stack([jnp.sum(a.astype(jnp.float32) ** 2) for a in arrays])

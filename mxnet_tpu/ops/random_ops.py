@@ -1,0 +1,185 @@
+"""Random sampling operators.
+
+Reference: src/operator/random/ (sample_op.cc — uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial,
+multinomial_op.h, shuffle_op.cc, randint) driven by per-device RNG
+resources (include/mxnet/resource.h kRandom/kParallelRandom).
+
+TPU rebuild: stateless threefry keys. Every RNG op takes the PRNG key as
+its first parameter; dispatch injects a fresh counter-derived key per
+call (ops/registry.py:prep_inputs), so one compiled executable serves
+all calls while streams stay reproducible under `mx.random.seed`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jr():
+    import jax.random
+
+    return jax.random
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("_random_uniform", differentiable=False, needs_rng=True,
+          aliases=("random_uniform", "uniform"))
+def _uniform(rng_key, low=0.0, high=1.0, shape=(1,), dtype="float32"):
+    return _jr().uniform(rng_key, tuple(shape), np.dtype(dtype), low, high)
+
+
+@register("_random_normal", differentiable=False, needs_rng=True,
+          aliases=("random_normal", "normal", "normal_like"))
+def _normal(rng_key, loc=0.0, scale=1.0, shape=(1,), dtype="float32"):
+    dt = np.dtype(dtype)
+    return (_jr().normal(rng_key, tuple(shape), dt) * np.asarray(scale, dt)
+            + np.asarray(loc, dt))
+
+
+@register("_random_gamma", differentiable=False, needs_rng=True,
+          aliases=("random_gamma",))
+def _gamma(rng_key, alpha=1.0, beta=1.0, shape=(1,), dtype="float32"):
+    return _jr().gamma(rng_key, alpha, tuple(shape), np.dtype(dtype)) * beta
+
+
+@register("_random_exponential", differentiable=False, needs_rng=True,
+          aliases=("random_exponential",))
+def _exponential(rng_key, lam=1.0, shape=(1,), dtype="float32"):
+    return _jr().exponential(rng_key, tuple(shape), np.dtype(dtype)) / lam
+
+
+@register("_random_poisson", differentiable=False, needs_rng=True,
+          aliases=("random_poisson",))
+def _poisson(rng_key, lam=1.0, shape=(1,), dtype="float32"):
+    return _jr().poisson(rng_key, lam, tuple(shape)).astype(np.dtype(dtype))
+
+
+@register("_random_negative_binomial", differentiable=False, needs_rng=True,
+          aliases=("random_negative_binomial",))
+def _neg_binomial(rng_key, k=1, p=0.5, shape=(1,), dtype="float32"):
+    jr = _jr()
+    k1, k2 = jr.split(rng_key)
+    # NB(k, p) = Poisson(Gamma(k) * (1-p)/p)
+    lam = jr.gamma(k1, k, tuple(shape)) * ((1 - p) / p)
+    return jr.poisson(k2, lam).astype(np.dtype(dtype))
+
+
+@register("_random_generalized_negative_binomial", differentiable=False,
+          needs_rng=True, aliases=("random_generalized_negative_binomial",))
+def _gen_neg_binomial(rng_key, mu=1.0, alpha=1.0, shape=(1,), dtype="float32"):
+    jr = _jr()
+    k1, k2 = jr.split(rng_key)
+    r = 1.0 / alpha
+    lam = jr.gamma(k1, r, tuple(shape)) * (mu * alpha)
+    return jr.poisson(k2, lam).astype(np.dtype(dtype))
+
+
+@register("_random_randint", differentiable=False, needs_rng=True,
+          aliases=("random_randint", "randint"))
+def _randint(rng_key, low=0, high=1, shape=(1,), dtype="int32"):
+    return _jr().randint(rng_key, tuple(shape), low, high, np.dtype(dtype))
+
+
+@register("sample_multinomial", differentiable=False, needs_rng=True,
+          aliases=("_sample_multinomial", "multinomial"))
+def _multinomial(rng_key, data, shape=1, get_prob=False, dtype="int32"):
+    jnp = _jnp()
+    jr = _jr()
+    n = shape if isinstance(shape, int) else int(np.prod(shape))
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        samples = jr.categorical(rng_key, logits, shape=(n,))
+        if isinstance(shape, int) and shape == 1:
+            samples = samples[0]
+    else:
+        samples = jr.categorical(rng_key, logits[:, None, :], axis=-1,
+                                 shape=(data.shape[0], n))
+        if isinstance(shape, int) and shape == 1:
+            samples = samples[:, 0]
+    out = samples.astype(np.dtype(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            logits,
+            samples.astype(jnp.int32).reshape(logits.shape[0], -1)
+            if data.ndim > 1 else samples.astype(jnp.int32).reshape(-1),
+            axis=-1)
+        if isinstance(shape, int) and shape == 1:
+            lp = lp.reshape(out.shape)
+        return out, lp
+    return out
+
+
+@register("_shuffle", differentiable=False, needs_rng=True, aliases=("shuffle",))
+def _shuffle(rng_key, data):
+    return _jr().permutation(rng_key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", differentiable=False, needs_rng=True)
+def _sample_unique_zipfian(rng_key, range_max=1, shape=(1,)):
+    jnp = _jnp()
+    u = _jr().uniform(rng_key, tuple(shape))
+    out = (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype(jnp.int64)
+    return jnp.clip(out, 0, range_max - 1)
+
+
+# sample_* vectorized-parameter variants (reference sample_op.cc: one
+# sample set per row of the parameter tensors).
+
+def _tail(shape):
+    return tuple(shape) if isinstance(shape, (tuple, list)) else ((shape,) if shape else ())
+
+
+@register("_sample_uniform", differentiable=False, needs_rng=True,
+          aliases=("sample_uniform",))
+def _sample_uniform(rng_key, low, high, shape=(), dtype="float32"):
+    tgt = tuple(low.shape) + _tail(shape)
+    u = _jr().uniform(rng_key, tgt, np.dtype(dtype))
+    extra = len(tgt) - low.ndim
+    lo = low.reshape(low.shape + (1,) * extra)
+    hi = high.reshape(high.shape + (1,) * extra)
+    return lo + u * (hi - lo)
+
+
+@register("_sample_normal", differentiable=False, needs_rng=True,
+          aliases=("sample_normal",))
+def _sample_normal(rng_key, mu, sigma, shape=(), dtype="float32"):
+    tgt = tuple(mu.shape) + _tail(shape)
+    z = _jr().normal(rng_key, tgt, np.dtype(dtype))
+    extra = len(tgt) - mu.ndim
+    return (mu.reshape(mu.shape + (1,) * extra)
+            + z * sigma.reshape(sigma.shape + (1,) * extra))
+
+
+@register("_sample_gamma", differentiable=False, needs_rng=True,
+          aliases=("sample_gamma",))
+def _sample_gamma(rng_key, alpha, beta, shape=(), dtype="float32"):
+    tgt = tuple(alpha.shape) + _tail(shape)
+    extra = len(tgt) - alpha.ndim
+    a = alpha.reshape(alpha.shape + (1,) * extra)
+    g = _jr().gamma(rng_key, a, tgt, np.dtype(dtype))
+    return g * beta.reshape(beta.shape + (1,) * extra)
+
+
+@register("_sample_exponential", differentiable=False, needs_rng=True,
+          aliases=("sample_exponential",))
+def _sample_exponential(rng_key, lam, shape=(), dtype="float32"):
+    tgt = tuple(lam.shape) + _tail(shape)
+    extra = len(tgt) - lam.ndim
+    e = _jr().exponential(rng_key, tgt, np.dtype(dtype))
+    return e / lam.reshape(lam.shape + (1,) * extra)
+
+
+@register("_sample_poisson", differentiable=False, needs_rng=True,
+          aliases=("sample_poisson",))
+def _sample_poisson(rng_key, lam, shape=(), dtype="float32"):
+    tgt = tuple(lam.shape) + _tail(shape)
+    extra = len(tgt) - lam.ndim
+    return _jr().poisson(rng_key, lam.reshape(lam.shape + (1,) * extra),
+                         tgt).astype(np.dtype(dtype))

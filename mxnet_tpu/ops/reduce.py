@@ -1,0 +1,146 @@
+"""Reduction and ordering operators.
+
+Reference: src/operator/tensor/broadcast_reduce_op_value.cc (sum/mean/
+max/min/prod/norm with axis/keepdims/exclude), ordering_op-inl.h
+(sort/argsort/topk via CUB on GPU).
+
+TPU rebuild: jnp reductions lower to XLA `reduce`, which tiles onto the
+VPU; sort/topk lower to XLA variadic sort / approx-top-k. CUB is
+subsumed by the compiler.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def _reduce(name, jfn, differentiable=True):
+    def fn(a, axis=None, keepdims=False, exclude=False):
+        jnp = _jnp()
+        ax = _axis(axis)
+        if exclude and ax is not None:
+            if isinstance(ax, int):
+                ax = (ax,)
+            ax = tuple(i for i in range(a.ndim) if i not in
+                       tuple(x % a.ndim for x in ax))
+        return jfn(jnp, a, ax, keepdims)
+
+    register(name, differentiable=differentiable)(fn)
+
+
+_reduce("sum", lambda jnp, a, ax, kd: jnp.sum(a, axis=ax, keepdims=kd))
+_reduce("mean", lambda jnp, a, ax, kd: jnp.mean(a, axis=ax, keepdims=kd))
+_reduce("max", lambda jnp, a, ax, kd: jnp.max(a, axis=ax, keepdims=kd))
+_reduce("min", lambda jnp, a, ax, kd: jnp.min(a, axis=ax, keepdims=kd))
+_reduce("prod", lambda jnp, a, ax, kd: jnp.prod(a, axis=ax, keepdims=kd))
+_reduce("nansum", lambda jnp, a, ax, kd: jnp.nansum(a, axis=ax, keepdims=kd))
+_reduce("nanprod", lambda jnp, a, ax, kd: jnp.nanprod(a, axis=ax, keepdims=kd))
+
+
+@register("norm")
+def _norm(a, ord=2, axis=None, keepdims=False):
+    jnp = _jnp()
+    ax = _axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(a), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdims))
+
+
+@register("sum_axis", aliases=("sum_mid_internal",))
+def _sum_axis(a, axis=None, keepdims=False):
+    return _jnp().sum(a, axis=_axis(axis), keepdims=keepdims)
+
+
+@register("argmax", differentiable=False)
+def _argmax(a, axis=None, keepdims=False):
+    jnp = _jnp()
+    out = jnp.argmax(a, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(np.float32)
+
+
+@register("argmin", differentiable=False)
+def _argmin(a, axis=None, keepdims=False):
+    jnp = _jnp()
+    out = jnp.argmin(a, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(np.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(a):
+    return _jnp().argmax(a, axis=1).astype(np.float32)
+
+
+@register("sort")
+def _sort(a, axis=-1, is_ascend=True):
+    jnp = _jnp()
+    out = jnp.sort(a, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", differentiable=False)
+def _argsort(a, axis=-1, is_ascend=True):
+    jnp = _jnp()
+    out = jnp.argsort(a, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(np.float32)
+
+
+@register("topk", differentiable=False)
+def _topk(a, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    jnp = _jnp()
+    ax = axis if axis is not None else -1
+    a_m = jnp.moveaxis(a, ax, -1)
+    key = a_m if is_ascend else -a_m
+    idx = jnp.argsort(key, axis=-1)[..., :k]
+    vals = jnp.take_along_axis(a_m, idx, axis=-1)
+    idx = jnp.moveaxis(idx, -1, ax).astype(np.float32)
+    vals = jnp.moveaxis(vals, -1, ax)
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        a_last = jnp.moveaxis(a, ax, -1)
+        order = jnp.argsort(key, axis=-1)
+        ranks = jnp.argsort(order, axis=-1)
+        mask = (ranks < k).astype(a.dtype)
+        return jnp.moveaxis(mask, -1, ax)
+    raise ValueError("unknown ret_typ %s" % ret_typ)
+
+
+@register("cumsum")
+def _cumsum(a, axis=None, dtype=None):
+    out = _jnp().cumsum(a, axis=axis)
+    if dtype is not None:
+        out = out.astype(np.dtype(dtype))
+    return out
+
+
+@register("histogram", differentiable=False)
+def _histogram(a, bin_cnt=10, range=None):
+    jnp = _jnp()
+    lo, hi = range if range is not None else (float(0), float(1))
+    counts, edges = jnp.histogram(a, bins=bin_cnt, range=(lo, hi))
+    return counts.astype(np.float32), edges.astype(np.float32)

@@ -1,0 +1,154 @@
+"""NNVM-style operator registry, TPU-native.
+
+Reference: the NNVM op registry (`NNVM_REGISTER_OP` with FCompute<cpu/gpu>,
+FGradient, FInferShape — include/mxnet/op_attr_types.h:115-283) plus the
+per-shape cuDNN autotune registry (src/operator/nn/cudnn/cudnn_algoreg-inl.h).
+
+TPU rebuild: an operator's FCompute is a pure JAX function
+``fn(*arrays, **attrs) -> array | tuple``. Dispatch compiles it through a
+per-(op, attrs) `jax.jit` wrapper; XLA then caches one executable per
+input shape/dtype signature — the cudnn_algoreg pattern generalized to
+whole-op compilation. FGradient comes for free from `jax.vjp` recorded on
+the autograd tape, replacing hand-written backward kernels.
+
+Inside a `hybridize()`/`bind()` trace the dispatcher detects JAX tracers
+and inlines `fn` directly, so a whole Gluon block or Symbol graph fuses
+into ONE XLA executable (the CachedOp seam, reference
+src/imperative/cached_op.cc).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import numpy as np
+
+__all__ = ["Operator", "register", "get", "list_all_ops", "invoke", "OP_REGISTRY"]
+
+OP_REGISTRY: dict[str, "Operator"] = {}
+
+
+def _freeze(value):
+    """Make op attrs hashable so they can key the executable cache."""
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    if isinstance(value, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in value.items()))
+    if isinstance(value, np.ndarray):
+        return (value.shape, str(value.dtype), value.tobytes())
+    return value
+
+
+class Operator:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (`mx.nd.<name>` / `mx.sym.<name>`).
+    fn : pure function of jax arrays + keyword attrs.
+    differentiable : whether autograd may record a vjp for it.
+    num_inputs : fixed arity or None for variadic.
+    aliases : extra registry names (reference keeps legacy aliases).
+    """
+
+    def __init__(self, name: str, fn: Callable, *, differentiable=True,
+                 num_inputs=None, aliases=(), needs_rng=False,
+                 train_aware=False):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.num_inputs = num_inputs
+        self.aliases = tuple(aliases)
+        self.needs_rng = needs_rng
+        self.train_aware = train_aware
+        self._jit_cache: dict = {}
+
+    def bound_fn(self, attrs, named=()):
+        """Return a positional-arrays closure: trailing `named` inputs are
+        bound by keyword (array-valued op kwargs like softmax's `length`)."""
+        fn = self.fn
+        if not named and not attrs:
+            return fn
+        n_named = len(named)
+
+        def call(*arrays):
+            pos = arrays[:len(arrays) - n_named] if n_named else arrays
+            kw = dict(zip(named, arrays[len(arrays) - n_named:])) if n_named else {}
+            return fn(*pos, **kw, **attrs)
+
+        return call
+
+    def jitted(self, attrs_key, attrs, named=()):
+        """Per-(op, attrs) compiled entry; XLA adds per-shape caching."""
+        key = (attrs_key, named)
+        hit = self._jit_cache.get(key)
+        if hit is None:
+            import jax
+
+            hit = jax.jit(self.bound_fn(attrs, named))
+            self._jit_cache[key] = hit
+        return hit
+
+    def __repr__(self):
+        return "Operator(%s)" % self.name
+
+
+def register(name, *, differentiable=True, num_inputs=None, aliases=(),
+             needs_rng=False, train_aware=False):
+    """Decorator: register a JAX FCompute under `name`.
+
+    RNG ops (`needs_rng=True`) take a PRNG key as their FIRST positional
+    parameter; dispatch supplies a fresh counter-derived key per call so
+    the compiled executable is reused while randomness varies
+    (mxnet_tpu/random.py)."""
+
+    def deco(fn):
+        op = Operator(name, fn, differentiable=differentiable,
+                      num_inputs=num_inputs, aliases=aliases,
+                      needs_rng=needs_rng, train_aware=train_aware)
+        OP_REGISTRY[name] = op
+        for a in aliases:
+            OP_REGISTRY[a] = op
+        return fn
+
+    return deco
+
+
+def get(name: str) -> Operator:
+    try:
+        return OP_REGISTRY[name]
+    except KeyError:
+        raise AttributeError("operator %r is not registered" % name) from None
+
+
+def list_all_ops():
+    """Reference: MXListAllOpNames (src/c_api/c_api_symbolic.cc)."""
+    return sorted(OP_REGISTRY)
+
+
+def _is_traced(arrays) -> bool:
+    import jax.core as jcore
+
+    return any(isinstance(a, jcore.Tracer) for a in arrays)
+
+
+def prep_inputs(op: Operator, arrays):
+    """Prepend a fresh PRNG key for RNG ops (key is a runtime input, so
+    one executable serves every call with fresh randomness)."""
+    if op.needs_rng:
+        from .. import random as _random
+
+        return [_random.next_key()] + list(arrays)
+    return arrays
+
+
+def invoke_raw(op: Operator, arrays, attrs, named=()):
+    """Run `op` on raw jax arrays, choosing traced-inline vs jitted path.
+    Trailing `named` entries of `arrays` are bound by keyword."""
+    arrays = prep_inputs(op, arrays)
+    attrs_key = _freeze(attrs)
+    if _is_traced(arrays):
+        # Inside an enclosing jit/vjp/vmap trace: inline so the whole
+        # surrounding graph compiles as one executable.
+        return op.bound_fn(attrs, named)(*arrays)
+    return op.jitted(attrs_key, attrs, named)(*arrays)

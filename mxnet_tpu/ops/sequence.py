@@ -1,0 +1,198 @@
+"""Sequence and linear-algebra operators.
+
+Reference: src/operator/sequence_mask.cc / sequence_last.cc /
+sequence_reverse.cc; src/operator/tensor/la_op.cc (potrf/gemm/trsm/
+syrk/gelqf/sumlogdiag — LAPACK/cuBLAS backed).
+
+TPU rebuild: masking is pure elementwise HLO; linalg lowers to XLA's
+cholesky/triangular_solve/qr which run on the MXU where possible.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _len_mask(sequence_length, maxlen, batch, use_sequence_length):
+    jnp = _jnp()
+    if use_sequence_length and sequence_length is not None:
+        lens = sequence_length.astype(jnp.int32)
+    else:
+        lens = jnp.full((batch,), maxlen, dtype=jnp.int32)
+    # (maxlen, batch) mask — MXNet sequence ops default to TNC layout.
+    return jnp.arange(maxlen)[:, None] < lens[None, :]
+
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or sequence_length is None:
+        return data
+    t = data.shape[axis]
+    batch_axis = 1 - axis
+    mask = jnp.arange(t)[:, None] < sequence_length.astype(jnp.int32)[None, :]
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, np.asarray(value, data.dtype))
+
+
+@register("SequenceLast", aliases=("sequence_last",))
+def _sequence_last(data, sequence_length=None, use_sequence_length=False,
+                   axis=0):
+    jnp = _jnp()
+    if not use_sequence_length or sequence_length is None:
+        idx = data.shape[axis] - 1
+        return jnp.take(data, idx, axis=axis)
+    lens = sequence_length.astype(jnp.int32) - 1
+    if axis == 0:
+        return data[lens, jnp.arange(data.shape[1])]
+    return data[jnp.arange(data.shape[0]), lens]
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                      axis=0):
+    jnp = _jnp()
+    t, b = data.shape[0], data.shape[1]
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    lens = sequence_length.astype(jnp.int32)
+    tt = jnp.arange(t)[:, None]
+    src = jnp.where(tt < lens[None, :], lens[None, :] - 1 - tt, tt)
+    return jnp.take_along_axis(
+        data, src.reshape((t, b) + (1,) * (data.ndim - 2)), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# linalg (reference la_op.cc suite)
+# ---------------------------------------------------------------------------
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-2):
+    jnp = _jnp()
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                  axis=-2):
+    jnp = _jnp()
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def _linalg_potrf(A, lower=True):
+    import jax
+
+    jnp = _jnp()
+    L = jnp.linalg.cholesky(A)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def _linalg_potri(A, lower=True):
+    jnp = _jnp()
+    # inverse from its Cholesky factor: inv(L L^T) = inv(L)^T inv(L)
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    import jax.scipy.linalg as jsl
+
+    Linv = jsl.solve_triangular(A, eye, lower=lower)
+    if lower:
+        return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+    return jnp.matmul(Linv, jnp.swapaxes(Linv, -1, -2))
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax.scipy.linalg as jsl
+
+    jnp = _jnp()
+    if rightside:
+        # solve X A = alpha B  <=>  A^T X^T = alpha B^T
+        X = jsl.solve_triangular(jnp.swapaxes(A, -1, -2),
+                                 jnp.swapaxes(B, -1, -2) * alpha,
+                                 lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(X, -1, -2)
+    return jsl.solve_triangular(A, B * alpha, lower=lower,
+                                trans=1 if transpose else 0)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    jnp = _jnp()
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    if rightside:
+        return alpha * jnp.matmul(B, tri)
+    return alpha * jnp.matmul(tri, B)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def _linalg_syrk(A, transpose=False, alpha=1.0):
+    jnp = _jnp()
+    if transpose:
+        return alpha * jnp.matmul(jnp.swapaxes(A, -1, -2), A)
+    return alpha * jnp.matmul(A, jnp.swapaxes(A, -1, -2))
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _linalg_sumlogdiag(A):
+    jnp = _jnp()
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",))
+def _linalg_gelqf(A):
+    jnp = _jnp()
+    # LQ of A = (QR of A^T)^T
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",))
+def _linalg_syevd(A):
+    jnp = _jnp()
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse",))
+def _linalg_inverse(A):
+    return _jnp().linalg.inv(A)
+
+
+@register("_linalg_det", aliases=("linalg_det",))
+def _linalg_det(A):
+    return _jnp().linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=("linalg_slogdet",))
+def _linalg_slogdet(A):
+    sign, logdet = _jnp().linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("khatri_rao")
+def _khatri_rao(*args):
+    jnp = _jnp()
+    out = args[0]
+    for b in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, b).reshape(
+            out.shape[0] * b.shape[0], *out.shape[1:])
+    return out

@@ -1,0 +1,75 @@
+"""Random state management.
+
+Reference: per-device RNG resources (include/mxnet/resource.h kRandom /
+kParallelRandom, src/common/random_generator.*) with `mx.random.seed`.
+
+TPU rebuild: counter-based stateless PRNG (threefry). A process-global
+root key + monotonically increasing counter replaces mutable generator
+state; `next_key()` = fold_in(root, counter++). Inside a hybridize/jit
+trace, a *traced* key (provided as an executable input by CachedOp) is
+folded instead, so compiled training steps get fresh randomness every
+invocation — the part stateful RNG cannot express under XLA.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "trace_key_scope", "get_state"]
+
+_state = threading.local()
+_root_seed = 0
+_counter = [0]
+_lock = threading.Lock()
+
+
+def _root_key():
+    import jax
+
+    return jax.random.PRNGKey(_root_seed)
+
+
+def seed(seed_state, ctx="all"):
+    """Reference: mx.random.seed (python/mxnet/random.py). Resets the
+    root key and counter; per-ctx seeding is meaningless with stateless
+    keys so `ctx` is accepted and ignored."""
+    global _root_seed
+    with _lock:
+        _root_seed = int(seed_state)
+        _counter[0] = 0
+
+
+def next_key():
+    """Return a fresh PRNG key. Inside a trace scope, derive from the
+    traced key so randomness is an executable input, not a baked constant."""
+    import jax
+
+    tk = getattr(_state, "trace_keys", None)
+    if tk:
+        key, cnt = tk[-1]
+        tk[-1] = (key, cnt + 1)
+        return jax.random.fold_in(key, cnt)
+    with _lock:
+        c = _counter[0]
+        _counter[0] += 1
+    return jax.random.fold_in(_root_key(), c)
+
+
+class trace_key_scope:
+    """Context manager installing a traced key for ops executed during a
+    jit trace (used by CachedOp / hybridized blocks)."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        if not hasattr(_state, "trace_keys"):
+            _state.trace_keys = []
+        _state.trace_keys.append((self.key, 0))
+        return self
+
+    def __exit__(self, *a):
+        _state.trace_keys.pop()
+
+
+def get_state():
+    return (_root_seed, _counter[0])

@@ -1,0 +1,42 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's GPU test suite
+trick of re-running unit tests per context, tests/python/gpu/, maps to:
+same tests, cpu backend, multi-device sharding exercised for real). The
+driver's separate dryrun validates the multi-chip path too.
+"""
+import os
+import sys
+
+# Must be set before jax initializes its backends.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded(request):
+    """Reproducible-but-random seeds per test (reference:
+    tests/python/unittest/common.py @with_seed)."""
+    seed = np.random.randint(0, 2 ** 31)
+    np.random.seed(seed)
+    import mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield
+    # On failure pytest reports; seed printed for reproduction.
+    if request.node.rep_call.failed if hasattr(request.node, "rep_call") else False:
+        print("test seed:", seed)
+
+
+def pytest_runtest_makereport(item, call):
+    pass

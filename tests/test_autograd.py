@@ -1,0 +1,162 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x.sum())
+    y.backward()
+    expect = np.exp(10.0)
+    assert np.allclose(x.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30, 300])
+
+
+def test_multiple_uses():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [5.0])  # 2x + 1
+
+
+def test_dot_grad():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 2).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b).sum()
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), b.asnumpy().sum(axis=1)[None, :].repeat(3, 0),
+                       atol=1e-5)
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (2 * x).sum()
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6, 6])
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 10  # not recorded
+        w = y + 1
+    w.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    with autograd.record():
+        y = (x * x).sum()
+    grads = autograd.grad([y], [x])
+    assert np.allclose(grads[0].asnumpy(), [2, 4, 6])
+
+
+def test_mark_variables():
+    x = nd.array([4.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = nd.sqrt(x)
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [0.25])
+
+
+def test_mutation_does_not_corrupt_tape():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    x += 100  # mutate after recording — tape must keep the snapshot
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self._saved = y
+            return y
+
+        def backward(self, dy):
+            y = self._saved
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+        z = y.sum()
+    z.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), sig * (1 - sig), atol=1e-5)
+
+
+def test_softmax_output_grad():
+    data = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3], dtype="float32")
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    sm = np.exp(data.asnumpy())
+    sm /= sm.sum(1, keepdims=True)
+    expect = sm.copy()
+    expect[np.arange(4), [0, 1, 2, 3]] -= 1
+    assert np.allclose(data.grad.asnumpy(), expect, atol=1e-5)
+
+
+def test_detach():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [9.0])  # only d(9*x)/dx

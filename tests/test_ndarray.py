@@ -1,0 +1,207 @@
+"""NDArray unit tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    assert np.allclose(a.asnumpy(), 0)
+
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    assert b.sum().asscalar() == 4
+
+    c = nd.full((2, 2), 7.5)
+    assert np.allclose(c.asnumpy(), 7.5)
+
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    assert d.dtype == np.int64 or d.dtype == np.int32
+
+    e = nd.array(np.random.rand(3, 3))
+    assert e.dtype == np.float32  # float64 downcast like the reference
+
+    f = nd.arange(0, 10, 2)
+    assert np.allclose(f.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    y = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert np.allclose((x + y).asnumpy(), [[11, 22], [33, 44]])
+    assert np.allclose((y - x).asnumpy(), [[9, 18], [27, 36]])
+    assert np.allclose((x * y).asnumpy(), [[10, 40], [90, 160]])
+    assert np.allclose((y / x).asnumpy(), [[10, 10], [10, 10]])
+    assert np.allclose((x + 1).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((1 + x).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((2 - x).asnumpy(), [[1, 0], [-1, -2]])
+    assert np.allclose((x ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert np.allclose((-x).asnumpy(), [[-1, -2], [-3, -4]])
+    assert np.allclose((x > 2).asnumpy(), [[0, 0], [1, 1]])
+    assert np.allclose((x == 2).asnumpy(), [[0, 1], [0, 0]])
+
+
+def test_inplace_versioning():
+    x = nd.ones((2, 2))
+    v0 = x.version
+    x += 1
+    assert x.version == v0 + 1
+    assert np.allclose(x.asnumpy(), 2)
+    y = x  # alias sees the mutation (same NDArray object)
+    x *= 2
+    assert np.allclose(y.asnumpy(), 4)
+
+
+def test_broadcast():
+    x = nd.ones((2, 1, 3))
+    y = nd.ones((1, 4, 3))
+    z = x + y
+    assert z.shape == (2, 4, 3)
+    b = nd.ones((1, 3)).broadcast_to((5, 3))
+    assert b.shape == (5, 3)
+
+
+def test_shape_ops():
+    x = nd.arange(24).reshape((2, 3, 4))
+    assert x.reshape((4, 6)).shape == (4, 6)
+    assert x.reshape((-1, 4)).shape == (6, 4)
+    assert x.reshape((0, -1)).shape == (2, 12)
+    assert x.transpose().shape == (4, 3, 2)
+    assert x.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert x.flatten().shape == (2, 12)
+    assert x.expand_dims(0).shape == (1, 2, 3, 4)
+    assert x.swapaxes(0, 2).shape == (4, 3, 2)
+    assert nd.concat(x, x, dim=1).shape == (2, 6, 4)
+    assert nd.stack(x, x, axis=0).shape == (2, 2, 3, 4)
+    parts = x.split(3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+
+
+def test_reduce():
+    x = nd.array(np.arange(12).reshape(3, 4))
+    assert x.sum().asscalar() == 66
+    assert np.allclose(x.sum(axis=0).asnumpy(), [12, 15, 18, 21])
+    assert np.allclose(x.mean(axis=1).asnumpy(), [1.5, 5.5, 9.5])
+    assert x.max().asscalar() == 11
+    assert x.min().asscalar() == 0
+    assert np.allclose(x.argmax(axis=1).asnumpy(), [3, 3, 3])
+    n = x.norm().asscalar()
+    assert abs(n - np.linalg.norm(np.arange(12))) < 1e-4
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4))
+    b = nd.array(np.random.rand(4, 5))
+    c = nd.dot(a, b)
+    assert c.shape == (3, 5)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    # batch_dot
+    x = nd.array(np.random.rand(2, 3, 4))
+    y = nd.array(np.random.rand(2, 4, 5))
+    z = nd.batch_dot(x, y)
+    assert z.shape == (2, 3, 5)
+
+
+def test_indexing():
+    x = nd.arange(24).reshape((4, 6))
+    assert x[1].shape == (6,)
+    assert x[1, 2].asscalar() == 8
+    assert x[1:3].shape == (2, 6)
+    assert x[:, 2:4].shape == (4, 2)
+    idx = nd.array([0, 2], dtype="int32")
+    assert nd.take(x, idx, axis=0).shape == (2, 6)
+    x[0] = 100.0
+    assert np.allclose(x.asnumpy()[0], 100)
+    x[1, 1] = -1.0
+    assert x.asnumpy()[1, 1] == -1
+
+
+def test_context_and_copy():
+    x = nd.ones((2, 2), ctx=mx.cpu(0))
+    assert x.context.device_type == "cpu"
+    y = x.copyto(mx.cpu(0))
+    assert np.allclose(y.asnumpy(), 1)
+    z = x.as_in_context(mx.cpu(0))
+    assert z is x
+    c = x.copy()
+    c += 1
+    assert np.allclose(x.asnumpy(), 1)  # copy is deep
+
+
+def test_astype():
+    x = nd.ones((2, 2))
+    y = x.astype("float16")
+    assert y.dtype == np.float16
+    z = x.astype(np.int32)
+    assert z.dtype == np.int32
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays")
+    a = nd.array(np.random.rand(3, 3))
+    b = nd.array(np.random.rand(2,))
+    nd.save(fname, [a, b])
+    out = nd.load(fname)
+    assert isinstance(out, list) and len(out) == 2
+    assert np.allclose(out[0].asnumpy(), a.asnumpy())
+    nd.save(fname, {"w": a, "b": b})
+    out = nd.load(fname)
+    assert set(out) == {"w", "b"}
+    assert np.allclose(out["b"].asnumpy(), b.asnumpy())
+
+
+def test_waitall_and_naive_engine():
+    x = nd.ones((8, 8))
+    y = nd.dot(x, x)
+    nd.waitall()
+    mx.engine.set_engine_type("NaiveEngine")
+    try:
+        z = nd.dot(y, y)
+        assert z.shape == (8, 8)
+    finally:
+        mx.engine.set_engine_type("ThreadedEnginePerDevice")
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(100,))
+    b = nd.random.uniform(0, 1, shape=(100,))
+    assert not np.allclose(a.asnumpy(), b.asnumpy())  # fresh keys per call
+    mx.random.seed(42)
+    a2 = nd.random.uniform(0, 1, shape=(100,))
+    assert np.allclose(a.asnumpy(), a2.asnumpy())  # reproducible
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(n.mean().asscalar())) < 0.2
+    r = nd.random.randint(0, 10, shape=(50,))
+    assert r.dtype == np.int32
+    assert int(r.max().asscalar()) < 10
+
+
+def test_one_hot_take_pick():
+    idx = nd.array([0, 2, 1], dtype="int32")
+    oh = nd.one_hot(idx, 3)
+    assert np.allclose(oh.asnumpy(), np.eye(3)[[0, 2, 1]])
+    x = nd.array([[1, 2, 3], [4, 5, 6]])
+    p = nd.pick(x, nd.array([0, 2]), axis=1)
+    assert np.allclose(p.asnumpy(), [1, 6])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0], [0.5, 2.5, 1.5]])
+    v = nd.topk(x, k=2, ret_typ="value")
+    assert np.allclose(v.asnumpy(), [[3, 2], [2.5, 1.5]])
+    s = nd.sort(x, axis=1)
+    assert np.allclose(s.asnumpy(), [[1, 2, 3], [0.5, 1.5, 2.5]])
+
+
+def test_where_clip():
+    x = nd.array([-2.0, -1.0, 0.0, 1.0, 2.0])
+    c = x.clip(-1, 1)
+    assert np.allclose(c.asnumpy(), [-1, -1, 0, 1, 1])
+    w = nd.where(x > 0, x, -x)
+    assert np.allclose(w.asnumpy(), [2, 1, 0, 1, 2])

@@ -1,0 +1,273 @@
+"""Operator tests (reference: tests/python/unittest/test_operator.py).
+
+Numeric checks against numpy + finite-difference gradient checks via the
+shipped test toolkit (mxnet_tpu/test_utils.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 10).astype(np.float32)
+    w = np.random.rand(3, 10).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4, atol=1e-4)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True, num_hidden=3)
+    assert_almost_equal(out, x @ w.T, rtol=1e-4, atol=1e-4)
+
+
+def test_fc_grad():
+    x = np.random.rand(3, 5).astype(np.float32)
+    w = np.random.rand(2, 5).astype(np.float32)
+    b = np.random.rand(2).astype(np.float32)
+    check_numeric_gradient(
+        lambda a, c, d: nd.FullyConnected(a, c, d, num_hidden=2), [x, w, b])
+
+
+def test_convolution_shapes():
+    x = nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    w = nd.array(np.random.rand(4, 3, 3, 3).astype(np.float32))
+    b = nd.array(np.zeros(4, np.float32))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1))
+    assert out.shape == (2, 4, 8, 8)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, stride=(2, 2),
+                         pad=(1, 1))
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_convolution_vs_numpy():
+    # 1x1 conv == per-pixel matmul
+    x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+    w = np.random.rand(4, 3, 1, 1).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                         kernel=(1, 1), num_filter=4)
+    expect = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_grad():
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = np.random.rand(2, 2, 3, 3).astype(np.float32)
+    check_numeric_gradient(
+        lambda a, c: nd.Convolution(a, c, no_bias=True, kernel=(3, 3),
+                                    num_filter=2), [x, w],
+        rtol=2e-2, atol=5e-3)
+
+
+def test_grouped_and_depthwise_conv():
+    x = nd.array(np.random.rand(1, 4, 6, 6).astype(np.float32))
+    w = nd.array(np.random.rand(4, 1, 3, 3).astype(np.float32))
+    out = nd.Convolution(x, w, no_bias=True, kernel=(3, 3), num_filter=4,
+                         num_group=4)
+    assert out.shape == (1, 4, 4, 4)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert_almost_equal(out, [[[[5, 7], [13, 15]]]])
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert_almost_equal(out, [[[[2.5, 4.5], [10.5, 12.5]]]])
+    out = nd.Pooling(nd.array(x), global_pool=True, pool_type="max")
+    assert out.shape == (1, 1, 1, 1)
+    assert out.asscalar() == 15
+
+
+def test_batchnorm_train_eval():
+    np.random.seed(0)
+    x = np.random.rand(8, 3, 4, 4).astype(np.float32) * 5
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    out, new_mm, new_mv = nd.BatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mm),
+        nd.array(mv), fix_gamma=False, training=True, momentum=0.9)
+    o = out.asnumpy()
+    assert abs(o.mean()) < 1e-4
+    assert abs(o.std() - 1) < 1e-2
+    # moving stats moved toward batch stats
+    assert np.all(new_mm.asnumpy() != 0)
+    # eval mode uses moving stats
+    out_eval, _, _ = nd.BatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mm),
+        nd.array(mv), fix_gamma=False, training=False)
+    assert_almost_equal(out_eval, x, rtol=1e-3, atol=1e-3)  # mm=0, mv=1 → identity-ish
+
+
+def test_layernorm():
+    x = np.random.rand(4, 10).astype(np.float32)
+    g = np.random.rand(10).astype(np.float32)
+    b = np.random.rand(10).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expect = (x - mean) / np.sqrt(var + 1e-5) * g + b
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-4)
+    check_numeric_gradient(lambda a: nd.LayerNorm(a, nd.array(g), nd.array(b)),
+                           [x], rtol=2e-2, atol=5e-3)
+
+
+def test_activations():
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="relu"),
+                        np.maximum(x, 0))
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="sigmoid"),
+                        1 / (1 + np.exp(-x)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="tanh"),
+                        np.tanh(x), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="softrelu"),
+                        np.log1p(np.exp(x)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax():
+    x = np.random.rand(3, 5).astype(np.float32)
+    out = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(-1, keepdims=True), rtol=1e-4, atol=1e-5)
+    ls = nd.log_softmax(nd.array(x))
+    assert_almost_equal(nd.exp(ls), out, rtol=1e-4, atol=1e-5)
+    wgt = nd.array(np.random.rand(3, 5).astype(np.float32))
+    check_numeric_gradient(lambda a: nd.softmax(a) * wgt, [x],
+                           rtol=2e-2, atol=5e-3)
+
+
+def test_dropout():
+    x = nd.ones((100, 100))
+    out = nd.Dropout(x, p=0.5, training=True)
+    o = out.asnumpy()
+    frac = (o == 0).mean()
+    assert 0.3 < frac < 0.7
+    kept = o[o != 0]
+    assert np.allclose(kept, 2.0, atol=1e-5)  # inverted dropout scaling
+    out_eval = nd.Dropout(x, p=0.5, training=False)
+    assert np.allclose(out_eval.asnumpy(), 1.0)
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(out, w[[1, 3, 5]])
+    # gradient scatters into rows
+    wn = nd.array(w)
+    wn.attach_grad()
+    with autograd.record():
+        y = nd.Embedding(nd.array(idx), wn, input_dim=10, output_dim=4).sum()
+    y.backward()
+    g = wn.grad.asnumpy()
+    assert np.allclose(g[[1, 3, 5]], 1)
+    assert np.allclose(g[[0, 2, 4, 6, 7, 8, 9]], 0)
+
+
+def test_transpose_deconv():
+    x = nd.array(np.random.rand(1, 2, 4, 4).astype(np.float32))
+    w = nd.array(np.random.rand(2, 3, 3, 3).astype(np.float32))
+    out = nd.Deconvolution(x, w, no_bias=True, kernel=(3, 3), num_filter=3,
+                           stride=(2, 2))
+    assert out.shape[1] == 3
+    assert out.shape[2] == 9  # (4-1)*2 + 3
+
+
+def test_sequence_ops():
+    data = np.arange(24, dtype=np.float32).reshape(4, 2, 3)  # (T, N, C)
+    lens = nd.array([2, 3], dtype="float32")
+    masked = nd.SequenceMask(nd.array(data), lens, use_sequence_length=True,
+                             value=-1.0)
+    m = masked.asnumpy()
+    assert np.allclose(m[2:, 0], -1)
+    assert np.allclose(m[:2, 0], data[:2, 0])
+    assert np.allclose(m[3, 1], -1)
+    last = nd.SequenceLast(nd.array(data), lens, use_sequence_length=True)
+    assert np.allclose(last.asnumpy()[0], data[1, 0])
+    assert np.allclose(last.asnumpy()[1], data[2, 1])
+    rev = nd.SequenceReverse(nd.array(data), lens, use_sequence_length=True)
+    assert np.allclose(rev.asnumpy()[0, 0], data[1, 0])
+    assert np.allclose(rev.asnumpy()[1, 0], data[0, 0])
+    assert np.allclose(rev.asnumpy()[2:, 0], data[2:, 0])
+
+
+def test_linalg():
+    a = np.random.rand(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = nd.linalg.potrf(nd.array(spd))
+    assert_almost_equal(nd.dot(L, L.T), spd, rtol=1e-3, atol=1e-3)
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    y = np.random.rand(2, 4, 5).astype(np.float32)
+    out = nd.linalg.gemm2(nd.array(x), nd.array(y))
+    assert_almost_equal(out, x @ y, rtol=1e-4, atol=1e-4)
+    sld = nd.linalg.sumlogdiag(nd.array(spd))
+    assert_almost_equal(sld, np.log(np.diag(spd)).sum(), rtol=1e-4, atol=1e-4)
+
+
+def test_optimizer_ops():
+    w = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.0)
+    assert_almost_equal(out, w - 0.1 * g, rtol=1e-5, atol=1e-6)
+    mom = np.zeros(5, np.float32)
+    new_w, new_mom = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(mom),
+                                       lr=0.1, momentum=0.9)
+    assert_almost_equal(new_w, w - 0.1 * g, rtol=1e-5, atol=1e-6)
+    mean = np.zeros(5, np.float32)
+    var = np.zeros(5, np.float32)
+    new_w, new_mean, new_var = nd.adam_update(
+        nd.array(w), nd.array(g), nd.array(mean), nd.array(var), lr=0.01)
+    assert new_w.shape == (5,)
+
+
+def test_elementwise_grad_sampling():
+    for opname in ["exp", "log", "sigmoid", "tanh", "sqrt", "square", "relu"]:
+        x = np.random.rand(3, 4).astype(np.float32) + 0.5
+        check_numeric_gradient(lambda a, op=opname: getattr(nd, op)(a), [x],
+                               rtol=2e-2, atol=5e-3)
+
+
+def test_lrn():
+    x = np.random.rand(2, 8, 4, 4).astype(np.float32)
+    out = nd.LRN(nd.array(x), nsize=5)
+    assert out.shape == x.shape
+
+
+def test_instance_norm_l2norm():
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    out = nd.InstanceNorm(nd.array(x), nd.ones((3,)), nd.zeros((3,)))
+    o = out.asnumpy()
+    assert abs(o[0, 0].mean()) < 1e-4
+    out = nd.L2Normalization(nd.array(x))
+    o = out.asnumpy().reshape(2, -1)
+    assert np.allclose((o ** 2).sum(1), 1, atol=1e-4)
+
+
+def test_upsampling():
+    x = nd.array(np.random.rand(1, 2, 3, 3).astype(np.float32))
+    out = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert out.shape == (1, 2, 6, 6)
+    assert np.allclose(out.asnumpy()[0, 0, 0, 0], x.asnumpy()[0, 0, 0, 0])
+
+
+def test_smooth_l1_where():
+    x = np.array([-2.0, -0.5, 0.5, 2.0], np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0)
+    expect = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    assert_almost_equal(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_gather_scatter():
+    data = np.random.rand(4, 5).astype(np.float32)
+    idx = np.array([[0, 2], [1, 3]], np.float32)
+    out = nd.gather_nd(nd.array(data), nd.array(idx))
+    assert np.allclose(out.asnumpy(), data[[0, 2], [1, 3]])
+    sc = nd.scatter_nd(nd.array(np.array([1.0, 2.0], np.float32)),
+                       nd.array(idx), shape=(4, 5))
+    s = sc.asnumpy()
+    assert s[0, 1] == 1 and s[2, 3] == 2 and s.sum() == 3
