@@ -1,0 +1,92 @@
+"""CachedOp — the hybrid JIT unit.
+
+Reference: src/imperative/cached_op.cc/.h (Gluon `hybridize()` backend:
+caches the traced NNVM graph, static_alloc pre-plans memory, bulking
+fuses segments; SURVEY.md §3.3).
+
+TPU rebuild — this is THE seam where the design diverges from the
+reference on purpose: instead of replaying a cached graph op-by-op
+through the engine, the entire traced computation compiles to ONE XLA
+executable per input-shape signature (jax.jit). XLA buffer assignment
+replaces NNVM PlanMemory; fusion replaces segment bulking; retracing on
+a new shape replaces bucketed re-binds (per-signature executable cache =
+the cudnn_algoreg pattern at whole-graph scope).
+
+Under `autograd.record()`, a CachedOp call records a single tape node;
+its backward is a cached jitted vjp of the whole graph, rematerializing
+the forward inside the backward executable (`jax.checkpoint` semantics —
+the TPU-friendly compute/memory trade, cf. MXNET_BACKWARD_DO_MIRROR).
+
+Randomness inside the graph (Dropout) is threaded as a PRNG-key input,
+so one executable serves every call with fresh masks.
+"""
+from __future__ import annotations
+
+from . import autograd
+from . import random as _random
+from .ops.registry import Operator, _freeze
+from .ndarray.ndarray import NDArray, _wrap_outputs
+
+__all__ = ["CachedOp"]
+
+
+class CachedOp:
+    """Compile a python function over NDArrays into a cached XLA executable.
+
+    Parameters
+    ----------
+    fn : callable(*args) -> NDArray | list[NDArray]
+        Pure function using `nd` ops / NDArray methods. Called with
+        tracer-backed NDArrays during compilation.
+    num_params : int
+        How many leading arguments of `fn` are parameters (their
+        gradients flow to `.grad` buffers on backward).
+    static_alloc, static_shape, inline_limit, forward_bulk_size,
+    backward_bulk_size : accepted for reference API parity
+        (CachedOpConfig, cached_op.h:32-56). XLA owns memory planning and
+        fusion, so they are advisory here.
+    """
+
+    _counter = [0]
+
+    def __init__(self, fn, num_params=0, static_alloc=False, static_shape=False,
+                 **flags):
+        self._fn = fn
+        self._num_params = num_params
+        self._flags = flags
+        CachedOp._counter[0] += 1
+        name = "_cached_op_%d" % CachedOp._counter[0]
+
+        cached = self
+
+        def pure(rng_key, *arrays, training=False):
+            params = arrays[:cached._num_params]
+            inputs = arrays[cached._num_params:]
+            with autograd.pause(train_mode=training):
+                with _random.trace_key_scope(rng_key):
+                    nd_params = [NDArray(p) for p in params]
+                    nd_inputs = [NDArray(x) for x in inputs]
+                    out = cached._fn(*(nd_params + nd_inputs))
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data if isinstance(o, NDArray) else o for o in out)
+            return out._data if isinstance(out, NDArray) else out
+
+        self._op = Operator(name, pure, needs_rng=True, train_aware=True)
+
+    def __call__(self, *args, out=None):
+        """Forward (reference: CachedOp::Forward via MXInvokeCachedOp).
+        First call per shape signature compiles; later calls reuse the
+        executable."""
+        attrs = {"training": autograd.is_training()}
+        arrays = [x._data if isinstance(x, NDArray) else x for x in args]
+        ctx = next((x._ctx for x in args if isinstance(x, NDArray)), None)
+
+        from .ops import registry as _reg
+
+        if autograd.is_recording():
+            raw = autograd._record_op(self._op, list(args), arrays, attrs)
+            result = _wrap_outputs(raw, ctx, out=out)
+            autograd._attach_outputs(result)
+            return result
+        raw = _reg.invoke_raw(self._op, arrays, attrs)
+        return _wrap_outputs(raw, ctx, out=out)

@@ -1,0 +1,19 @@
+"""Gluon — the imperative-first user API (reference: python/mxnet/gluon/)."""
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from .trainer import Trainer
+from . import utils
+
+
+def __getattr__(name):
+    if name in ("rnn", "data", "model_zoo", "contrib"):
+        import importlib
+
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

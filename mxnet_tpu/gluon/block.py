@@ -1,0 +1,373 @@
+"""Gluon Block / HybridBlock.
+
+Reference: python/mxnet/gluon/block.py (Block :126, HybridBlock :672
+with _build_cache/_call_cached_op :749-796, SymbolBlock :953,
+save/load_parameters :314-356).
+
+TPU rebuild: `hybridize()` does not build an NNVM graph — the block's
+unmodified Python forward is traced by jax.jit through CachedOp
+(mxnet_tpu/cached_op.py), with parameters lifted to executable inputs
+via parameter.override() and aux-state writes (BatchNorm running stats)
+returned as extra outputs. One XLA executable per (input-signature,
+train-mode); shape changes retrace automatically — MXNet's bucketing
+rebinds, subsumed.
+
+Deferred initialization: layers implement `infer_shape(*args)`; on first
+forward with unknown param shapes the hook fills them from the inputs
+(replacing the reference's symbolic shape-inference pass).
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from .. import autograd
+from ..cached_op import CachedOp
+from .parameter import (Parameter, ParameterDict, DeferredInitializationError,
+                        override, tracing_overrides)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+_naming = threading.local()
+
+
+class _BlockScope:
+    """Name scoping for parameter prefixes (reference: block.py:_BlockScope)."""
+
+    _counters = {}
+
+    @staticmethod
+    def create(prefix, params, hint):
+        if prefix is None:
+            cnt = _BlockScope._counters.get(hint, 0)
+            _BlockScope._counters[hint] = cnt + 1
+            prefix = "%s%d_" % (hint, cnt)
+        if params is None:
+            params = ParameterDict(prefix)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return prefix, params
+
+
+class Block:
+    """Base building block (reference: gluon/block.py:Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        hint = self._alias()
+        self._prefix, self._params = _BlockScope.create(prefix, params, hint)
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def collect_params(self, select=None):
+        """All parameters of self + descendants (reference: block.py:
+        collect_params)."""
+        out = ParameterDict(self._params.prefix)
+        pattern = re.compile(select) if select else None
+        seen = set()
+
+        def visit(block):
+            if id(block) in seen:
+                return
+            seen.add(id(block))
+            for name, p in block._params.items():
+                if pattern is None or pattern.match(name):
+                    out._params[name] = p
+            for child in block._children.values():
+                visit(child)
+
+        visit(self)
+        return out
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        ret = {}
+        for name, p in self._reg_params.items():
+            ret[prefix + name] = p
+        for cname, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + cname + "."))
+        return ret
+
+    def save_parameters(self, filename):
+        """Structured param file (reference: block.py:314 — flat
+        attribute-path names, portable across prefixes)."""
+        params = self._collect_params_with_prefix()
+        arg = {}
+        for name, p in params.items():
+            if p._data is None:
+                continue
+            arg[name] = p.data()
+        nd.save(filename, arg)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not isinstance(loaded, dict):
+            raise ValueError("%s is not a parameter file" % filename)
+        for name, p in params.items():
+            if name in loaded:
+                if p.shape is None or p._data is None:
+                    p.shape = loaded[name].shape
+                    p.initialize(ctx=ctx)
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise ValueError("Parameter %s missing in %s" % (name, filename))
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise ValueError("Extra parameters in %s: %s" % (filename, extra))
+
+    # legacy aliases (reference keeps both save_params/save_parameters)
+    def save_params(self, filename):
+        self.save_parameters(filename)
+
+    def load_params(self, filename, ctx=None, **kwargs):
+        self.load_parameters(filename, ctx=ctx, **kwargs)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        n_params = sum(int(np.prod(p.shape)) for p in
+                       self.collect_params().values() if p.shape)
+        print("Total params: %d" % n_params)
+        return out
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "("]
+        for name, child in self._children.items():
+            mod = repr(child).replace("\n", "\n  ")
+            lines.append("  (%s): %s" % (name, mod))
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class HybridBlock(Block):
+    """Block compilable to a single XLA executable (reference:
+    gluon/block.py:HybridBlock — hybrid_forward(F, x, **params))."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._cached_op_params = None
+        self._cached_aux = None
+        self._cached_n_out = None
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_op = None
+        super().hybridize(active, **kwargs)
+
+    def infer_shape(self, *args):
+        """Fill deferred parameter shapes from input shapes. Layers with
+        deferred params override this."""
+        for child in self._children.values():
+            pass  # composite blocks infer via their children during forward
+
+    def _ensure_init(self, *args):
+        try:
+            return {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(*args)
+            for p in self._reg_params.values():
+                if p._deferred_init is not None:
+                    p._finish_deferred_init(p.shape)
+            return {k: p.data() for k, p in self._reg_params.items()}
+
+    def forward(self, x, *args):
+        params = self._ensure_init(x, *args)
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def _build_cache(self, *args):
+        # Trigger any deferred init with a real (non-traced) pass context:
+        # shapes are known from args.
+        params = list(self.collect_params().values())
+        deferred = [p for p in params if p._data is None and
+                    p._deferred_init is not None]
+        if deferred:
+            with autograd.pause():
+                self.forward(*args)
+        params = [p for p in self.collect_params().values()
+                  if p._data is not None]
+        self._cached_op_params = params
+        n = len(params)
+        block = self
+
+        def fn(*xs):
+            ps, ins = xs[:n], xs[n:]
+            ov = override(dict(zip(params, ps)))
+            with ov:
+                out = block.forward(*ins)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            aux = list(ov.writes.keys())
+            block._cached_aux = aux
+            block._cached_n_out = len(outs)
+            return tuple(outs) + tuple(ov.writes[p] for p in aux)
+
+        self._cached_op = CachedOp(fn, num_params=n, **self._flags)
+
+    def _call_cached_op(self, *args):
+        """Reference: block.py:_call_cached_op → CachedOp::Forward."""
+        if self._cached_op is None:
+            self._build_cache(*args)
+        param_data = [p.data() for p in self._cached_op_params]
+        result = self._cached_op(*(param_data + list(args)))
+        if not isinstance(result, tuple):
+            result = (result,)
+        n_out = self._cached_n_out
+        outs = result[:n_out]
+        aux_vals = result[n_out:]
+        for p, v in zip(self._cached_aux, aux_vals):
+            p.set_data(v)
+        return outs[0] if n_out == 1 else list(outs)
+
+    def __call__(self, *args, **kwargs):
+        if self._active and tracing_overrides() is None and \
+                not any(isinstance(a, NDArray) and _is_traced_nd(a) for a in args):
+            for hook in self._forward_pre_hooks:
+                hook(self, args)
+            out = self._call_cached_op(*args)
+            for hook in self._forward_hooks:
+                hook(self, args, out)
+            return out
+        return super().__call__(*args, **kwargs)
+
+    def export(self, path, epoch=0):
+        """Reference: HybridBlock.export writes json+params. We export the
+        parameter file; graph export arrives with the Symbol layer."""
+        self.save_parameters("%s-%04d.params" % (path, epoch))
+
+
+def _is_traced_nd(x):
+    import jax.core as jcore
+
+    return isinstance(x._data, jcore.Tracer)
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a symbol graph (reference: block.py:953).
+    Implemented with the Symbol layer (mxnet_tpu/symbol)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=None)
+        self._outputs = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..symbol import symbol as _symmod
+
+        arg_names = set()
+        for o in (outputs if isinstance(outputs, (list, tuple)) else [outputs]):
+            arg_names.update(o.list_arguments())
+        input_names = {i.name for i in self._inputs}
+        if params is None:
+            params = {}
+        for name in arg_names:
+            if name not in input_names:
+                p = params.get(name)
+                if isinstance(p, Parameter):
+                    self._params._params[name] = p
+                else:
+                    newp = self._params.get(name, allow_deferred_init=True)
+                    if p is not None:
+                        newp.shape = p.shape
+                        newp.initialize()
+                        newp.set_data(p)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import symbol as _symmod
+
+        sym = _symmod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_symmod.var(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file:
+            block.load_parameters(param_file, ctx=ctx, allow_missing=False,
+                                  ignore_extra=True)
+        return block
+
+    def forward(self, *args):
+        from ..symbol import symbol as _symmod
+
+        kwargs = {p.name: p.data() for p in self._params.values()}
+        for inp, val in zip(self._inputs, args):
+            kwargs[inp.name] = val
+        return self._outputs.eval_with(kwargs)

@@ -1,0 +1,138 @@
+"""Gluon Trainer.
+
+Reference: python/mxnet/gluon/trainer.py (Trainer :27, _init_kvstore
+:158, step/allreduce_grads/update, update_on_kvstore logic,
+save_states/load_states).
+
+TPU rebuild: single-context training updates in place via fused ops;
+multi-context data-parallel reduces gradients through the kvstore
+(XLA collectives / host reduction — kvstore package). The blessed
+high-throughput path compiles fwd+bwd+update into one executable
+(parallel.TrainStep); this Trainer keeps the imperative contract.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from .. import ndarray as nd
+from .parameter import ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a ParameterDict, dict or list")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._states = {}
+
+    def _check_contexts(self):
+        contexts = None
+        for p in self._params:
+            if p._data is None:
+                continue
+            ctx = p.list_ctx()
+            if contexts is None:
+                contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise ValueError(
+                    "optimizer_params must be empty when optimizer is an instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts or [None]]
+
+    def _init_kvstore(self):
+        """Create the kvstore lazily on first step (reference:
+        trainer.py:_init_kvstore). Needed only for multi-context."""
+        contexts = self._check_contexts()
+        if len(contexts) > 1 and self._kvstore_type:
+            from .. import kvstore as kvs
+
+            self._kvstore = kvs.create(self._kvstore_type
+                                       if isinstance(self._kvstore_type, str)
+                                       else "device")
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr_scheduler(self._optimizer.num_update) \
+            if self._optimizer.lr_scheduler else self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce_grads + update (reference: trainer.py:step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                grads = p.list_grad()
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            for upd, data, grad in zip(self._updaters, p.list_data(),
+                                       p.list_grad()):
+                upd(i, grad, data)
+
+    def save_states(self, fname):
+        """Reference: trainer.py:save_states — updater state pickles."""
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            payload = f.read()
+        for upd in self._updaters:
+            upd.set_states(payload)
+            upd.optimizer = self._optimizer

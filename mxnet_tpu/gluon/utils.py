@@ -1,0 +1,99 @@
+"""Gluon utilities.
+
+Reference: python/mxnet/gluon/utils.py (split_data, split_and_load,
+clip_global_norm, check_sha1, download).
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks (reference:
+    utils.py:split_data — feeds DataParallel executor groups)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d" % (str(data.shape), num_slice, batch_axis))
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split batch and load each slice to one context (reference:
+    utils.py:split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the joint L2 norm <= max_norm (reference:
+    utils.py:clip_global_norm)."""
+    assert len(arrays) > 0
+    total = 0.0
+    for a in arrays:
+        total += float((a * a).sum().asscalar())
+    total_norm = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+
+        warnings.warn("nan or inf found in gradient norm")
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Reference: utils.py:download. This environment has no egress;
+    only file:// URLs and existing cached files are supported."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+
+        shutil.copyfile(url[7:], fname)
+        return fname
+    raise RuntimeError(
+        "download(%s) requires network egress, which is unavailable; place "
+        "the file at %s manually" % (url, fname))

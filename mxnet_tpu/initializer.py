@@ -1,0 +1,243 @@
+"""Weight initializers.
+
+Reference: python/mxnet/initializer.py (registry; Uniform/Normal/
+Xavier/MSRAPrelu/Orthogonal/Bilinear/LSTMBias/One/Zero/Constant/Mixed).
+
+Initializers run host-side on numpy (they execute once at startup; the
+arrays are then placed in HBM), seeded from the framework RNG state.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from . import random as _random
+from .registry_util import Registry
+
+__all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Xavier",
+           "MSRAPrelu", "Orthogonal", "Bilinear", "One", "Zero", "Constant",
+           "LSTMBias", "Mixed", "registry", "register"]
+
+registry = Registry("initializer")
+register = registry.register
+
+
+class InitDesc(str):
+    """Name + attrs describing what is being initialized
+    (reference: initializer.py:InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+def _rng():
+    seed, counter = _random.get_state()
+    return np.random.RandomState((seed * 1000003 + counter * 7919) % (2 ** 31))
+
+
+class Initializer:
+    """Base class (reference: initializer.py:Initializer). Dispatches on
+    name suffix like the reference's InitDesc pattern matching."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init = desc.attrs.get("__init__", "")
+        if init:
+            return registry.create(init)._init_weight(desc, arr)
+        name = desc.lower()
+        if name.endswith("weight"):
+            return self._init_weight(desc, arr)
+        if name.endswith("bias"):
+            return self._init_bias(desc, arr)
+        if name.endswith("gamma"):
+            return self._init_one(desc, arr)
+        if name.endswith("beta"):
+            return self._init_zero(desc, arr)
+        if name.endswith("running_mean") or name.endswith("moving_mean"):
+            return self._init_zero(desc, arr)
+        if name.endswith("running_var") or name.endswith("moving_var"):
+            return self._init_one(desc, arr)
+        return self._init_weight(desc, arr)
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, desc, arr):
+        arr[...] = 0.0
+        return arr
+
+    def _init_one(self, desc, arr):
+        arr[...] = 1.0
+        return arr
+
+    def _init_zero(self, desc, arr):
+        arr[...] = 0.0
+        return arr
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        arr[...] = _rng().uniform(-self.scale, self.scale, arr.shape)
+        return arr
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        arr[...] = _rng().normal(0, self.sigma, arr.shape)
+        return arr
+
+
+@register("xavier")
+class Xavier(Initializer):
+    """Reference: initializer.py:Xavier (rnd_type uniform/gaussian,
+    factor_type avg/in/out)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError("Xavier requires ndim >= 2, got %s for %s"
+                             % (shape, desc))
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[...] = _rng().uniform(-scale, scale, shape)
+        else:
+            arr[...] = _rng().normal(0, scale, shape)
+        return arr
+
+
+@register("msra_prelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        rng = _rng()
+        if self.rand_type == "uniform":
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = rng.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[...] = (self.scale * q).reshape(arr.shape)
+        return arr
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    """Bilinear upsampling kernels (reference: initializer.py:Bilinear)."""
+
+    def _init_weight(self, desc, arr):
+        weight = np.zeros(arr.size, dtype=np.float64)
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(arr.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[...] = weight.reshape(shape)
+        return arr
+
+
+@register("one")
+@register("ones")
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[...] = 1.0
+        return arr
+
+
+@register("zero")
+@register("zeros")
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr[...] = 0.0
+        return arr
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=0.0)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        arr[...] = np.asarray(self.value.asnumpy() if hasattr(self.value, "asnumpy")
+                              else self.value)
+        return arr
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py:LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        arr[...] = 0.0
+        num_hidden = arr.shape[0] // 4
+        arr[num_hidden:2 * num_hidden] = self.forget_bias
+        return arr
+
+
+class Mixed:
+    """Pattern → initializer dispatch (reference: initializer.py:Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                return init(desc, arr)
+        raise ValueError("no initializer pattern matches %s" % desc)

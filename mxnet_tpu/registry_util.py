@@ -1,0 +1,43 @@
+"""Generic object registry (reference: python/mxnet/registry.py — the
+get_register_func/get_create_func pattern used by optimizers, metrics,
+initializers, iterators)."""
+from __future__ import annotations
+
+__all__ = ["Registry"]
+
+
+class Registry:
+    def __init__(self, nickname):
+        self.nickname = nickname
+        self._registry = {}
+
+    def register(self, name_or_cls, name=None):
+        if isinstance(name_or_cls, str):
+            reg_name = name_or_cls.lower()
+
+            def deco(cls):
+                self._registry[reg_name] = cls
+                return cls
+
+            return deco
+        cls = name_or_cls
+        self._registry[(name or cls.__name__).lower()] = cls
+        return cls
+
+    def create(self, name, *args, **kwargs):
+        if isinstance(name, str):
+            key = name.lower()
+            if key not in self._registry:
+                raise ValueError("%s %r is not registered (have: %s)"
+                                 % (self.nickname, name, sorted(self._registry)))
+            return self._registry[key](*args, **kwargs)
+        return name
+
+    def get(self, name):
+        return self._registry[name.lower()]
+
+    def __contains__(self, name):
+        return name.lower() in self._registry
+
+    def keys(self):
+        return list(self._registry)

@@ -1,0 +1,211 @@
+"""Gluon tests (reference: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_forward():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = nd.ones((2, 3))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    assert np.allclose(out.asnumpy(), np.ones((2, 3)) @ w.T + b, atol=1e-5)
+
+
+def test_deferred_init():
+    layer = nn.Dense(4)
+    layer.initialize()
+    out = layer(nd.ones((2, 7)))
+    assert out.shape == (2, 4)
+    assert layer.weight.shape == (4, 7)
+
+
+def test_sequential_mlp_training():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"),
+            nn.Dense(2))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    np.random.seed(0)
+    X = np.random.randn(64, 10).astype(np.float32)
+    W = np.random.randn(10, 2).astype(np.float32)
+    y = (X @ W).argmax(1).astype(np.float32)
+    xs, ys = nd.array(X), nd.array(y)
+    first = None
+    for _ in range(40):
+        with autograd.record():
+            out = net(xs)
+            loss = loss_fn(out, ys).mean()
+        loss.backward()
+        trainer.step(1)
+        if first is None:
+            first = float(loss.asscalar())
+    last = float(loss.asscalar())
+    assert last < first * 0.5, (first, last)
+
+
+def test_hybridize_equivalence():
+    np.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.rand(4, 5).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()  # first call compiles
+    hybrid2 = net(x).asnumpy()  # second call uses cache
+    assert np.allclose(eager, hybrid, atol=1e-5)
+    assert np.allclose(hybrid, hybrid2, atol=1e-6)
+
+
+def test_hybridize_training():
+    np.random.seed(2)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    X = np.random.randn(32, 6).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    xs, ys = nd.array(X), nd.array(y)
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            loss = loss_fn(net(xs), ys).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_batchnorm_layer():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32) * 10)
+    rm_before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        out = net(x)
+    rm_after = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm_before, rm_after)  # stats updated in train mode
+    out_eval = net(x)  # eval mode uses running stats
+    assert out_eval.shape == x.shape
+
+
+def test_batchnorm_hybrid_aux_update():
+    net = nn.BatchNorm(in_channels=2)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.rand(8, 2, 3, 3).astype(np.float32) * 5 + 3)
+    rm0 = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    rm1 = net.running_mean.data().asnumpy().copy()
+    assert not np.allclose(rm0, rm1)
+    with autograd.record():
+        net(x)
+    rm2 = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm1, rm2)  # keeps moving across calls
+
+
+def test_conv_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(),
+            nn.Conv2D(16, kernel_size=3, padding=1),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(10))
+    net.initialize()
+    x = nd.ones((2, 3, 16, 16))
+    out = net(x)
+    assert out.shape == (2, 10)
+    net.hybridize()
+    out2 = net(x)
+    assert out2.shape == (2, 10)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    fname = str(tmp_path / "model.params")
+    net.save_parameters(fname)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(fname)
+    x = nd.ones((1, 3))
+    assert np.allclose(net(x).asnumpy(), net2(x).asnumpy(), atol=1e-6)
+
+
+def test_dropout_layer_modes():
+    layer = nn.Dropout(0.5)
+    layer.initialize()
+    x = nd.ones((40, 40))
+    out_eval = layer(x)
+    assert np.allclose(out_eval.asnumpy(), 1.0)  # inference: identity
+    with autograd.record():
+        out_train = layer(x)
+    frac = (out_train.asnumpy() == 0).mean()
+    assert 0.25 < frac < 0.75
+
+
+def test_embedding_layer():
+    layer = nn.Embedding(10, 4)
+    layer.initialize()
+    idx = nd.array([1, 2, 3])
+    out = layer(idx)
+    assert out.shape == (3, 4)
+
+
+def test_trainer_optimizer_states(tmp_path):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    x = nd.ones((4, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer.load_states(fname)
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+
+
+def test_parameter_grad_req():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.weight.grad_req = "null"
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert np.allclose(net.bias.grad().asnumpy(), 1)
+
+
+def test_clip_global_norm():
+    arrays = [nd.array([[3.0, 4.0]]), nd.array([[0.0]])]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert abs(norm - 5.0) < 1e-5
+    assert np.allclose(arrays[0].asnumpy(), [[0.6, 0.8]], atol=1e-4)
+
+
+def test_split_and_load():
+    data = nd.arange(12).reshape((6, 2))
+    slices = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(0)])
+    assert len(slices) == 2
+    assert slices[0].shape == (3, 2)
