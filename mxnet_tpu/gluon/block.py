@@ -223,8 +223,8 @@ class HybridBlock(Block):
         self._active = False
         self._cached_op = None
         self._cached_op_params = None
-        self._cached_aux = None
-        self._cached_n_out = None
+        self._cached_aux = {}
+        self._cached_n_out = {}
         self._flags = {}
 
     def hybridize(self, active=True, **kwargs):
@@ -235,19 +235,22 @@ class HybridBlock(Block):
 
     def infer_shape(self, *args):
         """Fill deferred parameter shapes from input shapes. Layers with
-        deferred params override this."""
-        for child in self._children.values():
-            pass  # composite blocks infer via their children during forward
+        deferred params override this; composite blocks infer via their
+        children during forward."""
 
     def _ensure_init(self, *args):
+        # Use the replica living on the input's device (data-parallel
+        # forward on context i must read params[i], reference
+        # parameter.py:data(ctx)).
+        ctx = next((a.context for a in args if isinstance(a, NDArray)), None)
         try:
-            return {k: p.data() for k, p in self._reg_params.items()}
+            return {k: p.data(ctx) for k, p in self._reg_params.items()}
         except DeferredInitializationError:
             self.infer_shape(*args)
             for p in self._reg_params.values():
                 if p._deferred_init is not None:
                     p._finish_deferred_init(p.shape)
-            return {k: p.data() for k, p in self._reg_params.items()}
+            return {k: p.data(ctx) for k, p in self._reg_params.items()}
 
     def forward(self, x, *args):
         params = self._ensure_init(x, *args)
@@ -263,7 +266,11 @@ class HybridBlock(Block):
         deferred = [p for p in params if p._data is None and
                     p._deferred_init is not None]
         if deferred:
-            with autograd.pause():
+            # Empty override scope: children see an active trace and take
+            # their plain forward path, so this shape-discovery pass does
+            # not compile throwaway per-child executables (and aux writes
+            # are captured, not applied).
+            with autograd.pause(), override({}):
                 self.forward(*args)
         params = [p for p in self.collect_params().values()
                   if p._data is not None]
@@ -277,9 +284,13 @@ class HybridBlock(Block):
             with ov:
                 out = block.forward(*ins)
             outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            # Aux bookkeeping is per train-mode: the train and eval traces
+            # are distinct executables with different aux writes (BatchNorm
+            # updates running stats only in train mode).
             aux = list(ov.writes.keys())
-            block._cached_aux = aux
-            block._cached_n_out = len(outs)
+            mode = autograd.is_training()
+            block._cached_aux[mode] = aux
+            block._cached_n_out[mode] = len(outs)
             return tuple(outs) + tuple(ov.writes[p] for p in aux)
 
         self._cached_op = CachedOp(fn, num_params=n, **self._flags)
@@ -288,14 +299,16 @@ class HybridBlock(Block):
         """Reference: block.py:_call_cached_op → CachedOp::Forward."""
         if self._cached_op is None:
             self._build_cache(*args)
-        param_data = [p.data() for p in self._cached_op_params]
+        ctx = next((a.context for a in args if isinstance(a, NDArray)), None)
+        param_data = [p.data(ctx) for p in self._cached_op_params]
         result = self._cached_op(*(param_data + list(args)))
         if not isinstance(result, tuple):
             result = (result,)
-        n_out = self._cached_n_out
+        mode = autograd.is_training()
+        n_out = self._cached_n_out[mode]
         outs = result[:n_out]
         aux_vals = result[n_out:]
-        for p, v in zip(self._cached_aux, aux_vals):
+        for p, v in zip(self._cached_aux[mode], aux_vals):
             p.set_data(v)
         return outs[0] if n_out == 1 else list(outs)
 

@@ -18,65 +18,39 @@ __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "HybridLambda"]
 
 
-class Sequential(Block):
+class _SequentialMixin:
+    """Shared container behavior for Sequential/HybridSequential."""
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = self.__class__()
+            net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Sequential(_SequentialMixin, Block):
     """Stack of blocks (reference: basic_layers.py:Sequential)."""
 
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
 
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-
-    def forward(self, x, *args):
-        for block in self._children.values():
-            x = block(x)
-        return x
-
-    def __len__(self):
-        return len(self._children)
-
-    def __getitem__(self, key):
-        layers = list(self._children.values())
-        if isinstance(key, slice):
-            net = self.__class__()
-            net.add(*layers[key])
-            return net
-        return layers[key]
-
-    def __iter__(self):
-        return iter(self._children.values())
-
-    def hybridize(self, active=True, **kwargs):
-        super().hybridize(active, **kwargs)
-
-
-class HybridSequential(HybridBlock):
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-
-    def forward(self, x, *args):
-        for block in self._children.values():
-            x = block(x)
-        return x
-
-    def __len__(self):
-        return len(self._children)
-
-    def __getitem__(self, key):
-        layers = list(self._children.values())
-        if isinstance(key, slice):
-            net = self.__class__()
-            net.add(*layers[key])
-            return net
-        return layers[key]
-
-    def __iter__(self):
-        return iter(self._children.values())
+class HybridSequential(_SequentialMixin, HybridBlock):
+    """Hybridizable stack (reference: basic_layers.py:HybridSequential)."""
 
 
 class Dense(HybridBlock):
@@ -190,9 +164,11 @@ class InstanceNorm(HybridBlock):
         self._in_channels = in_channels
         self.gamma = self.params.get("gamma", shape=(in_channels,),
                                      init=gamma_initializer,
+                                     differentiable=scale,
                                      allow_deferred_init=True)
         self.beta = self.params.get("beta", shape=(in_channels,),
                                     init=beta_initializer,
+                                    differentiable=center,
                                     allow_deferred_init=True)
 
     def infer_shape(self, x, *args):
@@ -213,9 +189,11 @@ class LayerNorm(HybridBlock):
         self._epsilon = epsilon
         self.gamma = self.params.get("gamma", shape=(in_channels,),
                                      init=gamma_initializer,
+                                     differentiable=scale,
                                      allow_deferred_init=True)
         self.beta = self.params.get("beta", shape=(in_channels,),
                                     init=beta_initializer,
+                                    differentiable=center,
                                     allow_deferred_init=True)
 
     def infer_shape(self, x, *args):

@@ -109,6 +109,11 @@ class Parameter:
 
         if self._data is not None and not force_reinit:
             return
+        # A param-specific init (explicit arg or self.init, e.g. Dense's
+        # bias_initializer) must bypass the global initializer's
+        # name-suffix dispatch — reference marks this with the
+        # InitDesc attrs['__init__'] convention.
+        specific = init is not None or self.init is not None
         if init is None:
             init = self.init if self.init is not None else \
                 (default_init if default_init is not None else
@@ -124,15 +129,16 @@ class Parameter:
                 raise ValueError(
                     "Cannot initialize parameter %s with unknown shape %s"
                     % (self.name, self.shape))
-            self._deferred_init = (init, list(ctx))
+            self._deferred_init = (init, list(ctx), specific)
             return
-        self._finish_init(init, ctx)
+        self._finish_init(init, ctx, specific)
 
-    def _finish_init(self, init, ctx_list):
+    def _finish_init(self, init, ctx_list, specific=False):
         from .. import initializer as _initializer
 
         data = np.zeros(self.shape, dtype=self.dtype)
-        init_desc = _initializer.InitDesc(self.name)
+        init_desc = _initializer.InitDesc(
+            self.name, {"__init__": init} if specific else None)
         data = init(init_desc, data)
         self._data = {c: nd.array(data, ctx=c) for c in ctx_list}
         self._deferred_init = None
@@ -156,8 +162,8 @@ class Parameter:
         else:
             self.shape = tuple(s if s > 0 else n
                                for s, n in zip(self.shape, shape))
-        init, ctx = self._deferred_init
-        self._finish_init(init, ctx)
+        init, ctx, specific = self._deferred_init
+        self._finish_init(init, ctx, specific)
 
     # -- access ---------------------------------------------------------------
 

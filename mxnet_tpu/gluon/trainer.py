@@ -63,8 +63,7 @@ class Trainer:
         else:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
-        self._updaters = [opt.get_updater(self._optimizer)
-                          for _ in self._contexts or [None]]
+        self._updater = opt.get_updater(self._optimizer)
 
     def _init_kvstore(self):
         """Create the kvstore lazily on first step (reference:
@@ -118,21 +117,29 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        """Apply the optimizer ONCE per parameter on the first replica,
+        then broadcast the result (reference update_on_kvstore=True path,
+        module.py:_update_params_on_kvstore) — running one updater per
+        context would advance Adam's t / the LR schedule num_ctx times
+        per batch."""
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
                 continue
-            for upd, data, grad in zip(self._updaters, p.list_data(),
-                                       p.list_grad()):
-                upd(i, grad, data)
+            datas, grads = p.list_data(), p.list_grad()
+            # After _allreduce_grads all replicas hold the merged
+            # gradient, so updating replica 0 and broadcasting is
+            # equivalent to the server-side update.
+            self._updater(i, grads[0], datas[0])
+            for d in datas[1:]:
+                d[:] = datas[0].as_in_context(d.context)
 
     def save_states(self, fname):
         """Reference: trainer.py:save_states — updater state pickles."""
         with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=False))
+            f.write(self._updater.get_states(dump_optimizer=False))
 
     def load_states(self, fname):
         with open(fname, "rb") as f:
             payload = f.read()
-        for upd in self._updaters:
-            upd.set_states(payload)
-            upd.optimizer = self._optimizer
+        self._updater.set_states(payload)
+        self._updater.optimizer = self._optimizer

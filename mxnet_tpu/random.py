@@ -54,6 +54,13 @@ def next_key():
     return jax.random.fold_in(_root_key(), c)
 
 
+def advance():
+    """Advance the host counter (used by host-side consumers like
+    parameter initializers so successive draws differ)."""
+    with _lock:
+        _counter[0] += 1
+
+
 class trace_key_scope:
     """Context manager installing a traced key for ops executed during a
     jit trace (used by CachedOp / hybridized blocks)."""

@@ -1,0 +1,265 @@
+"""RecordIO file format — readers/writers and packed image records.
+
+Reference: python/mxnet/recordio.py (MXRecordIO :36, MXIndexedRecordIO,
+IRHeader + pack/unpack/pack_img/unpack_img :209-309) over dmlc-core's
+RecordIO framing. The on-disk format is reimplemented natively here
+(same magic/framing, so files interoperate with reference tooling):
+
+    record := kMagic(u32) | lrec(u32) | data | pad-to-4-bytes
+    lrec   := cflag(3 bits) << 29 | length(29 bits)
+
+cflag handles records spanning chunks: 0 = whole record, 1 = begin,
+2 = middle, 3 = end. A C++ chunked reader (src/ in this repo) provides
+the high-throughput path for the data pipeline; this module is the
+authoritative pure-python implementation and the fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import io as _pyio
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+_LREC_FLAG_BITS = 29
+_LREC_LENGTH_MASK = (1 << _LREC_FLAG_BITS) - 1
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << _LREC_FLAG_BITS) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> _LREC_FLAG_BITS, lrec & _LREC_LENGTH_MASK
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference recordio.py:36).
+
+    Parameters
+    ----------
+    uri : path to the .rec file
+    flag : 'r' or 'w'
+    """
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Override pickling behavior (multiprocessing DataLoader workers
+        re-open their own handle — reference recordio.py:__getstate__)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        d["record"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        is_open = d["is_open"]
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        """Re-open after fork (reference: recordio.py:_check_pid; the C++
+        runtime's pthread_atfork analogue for python file handles)."""
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError("RecordIO handle is not fork-safe; reset() first")
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.record.close()
+        self.is_open = False
+        self.pid = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        """Append one record."""
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        data = bytes(buf)
+        self.record.write(struct.pack("<II", _kMagic,
+                                      _encode_lrec(0, len(data))))
+        self.record.write(data)
+        pad = (4 - len(data) % 4) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def read(self):
+        """Read next record as bytes, or None at EOF."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        parts = []
+        while True:
+            header = self.record.read(8)
+            if len(header) < 8:
+                return b"".join(parts) if parts else None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _kMagic:
+                raise IOError("Invalid RecordIO magic number in %s" % self.uri)
+            cflag, length = _decode_lrec(lrec)
+            data = self.record.read(length)
+            if len(data) < length:
+                raise IOError("Truncated record in %s" % self.uri)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.record.read(pad)
+            parts.append(data)
+            if cflag in (0, 3):  # whole record or final continuation
+                return b"".join(parts)
+
+    def tell(self):
+        """Current file position (valid as an index key when writing)."""
+        return self.record.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.record.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a `.idx` sidecar mapping keys → byte offsets for
+    random access (reference recordio.py:MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        self.fidx = open(self.idx_path, self.flag)
+        if not self.writable and os.path.getsize(self.idx_path):
+            for line in iter(self.fidx.readline, ""):
+                line = line.strip().split("\t")
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["fidx"] = None
+        return d
+
+    def seek(self, idx):
+        """Seek to the record with key `idx`."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        """Random-access read of record `idx`."""
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        """Append record and index it under key `idx`."""
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# Header stored in front of packed image records: flag, label (scalar or
+# vector), image id, id2 (reference recordio.py:IRHeader, :209).
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack `IRHeader` + payload bytes into one record string
+    (reference recordio.py:pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload bytes)
+    (reference recordio.py:unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack a record into (IRHeader, decoded image ndarray HWC BGR)
+    (reference recordio.py:unpack_img; decode via mx.image backend)."""
+    header, s = unpack(s)
+    from .image import imdecode
+
+    img = imdecode(np.frombuffer(s, dtype=np.uint8), flag=iscolor,
+                   to_rgb=False)
+    if hasattr(img, "asnumpy"):
+        img = img.asnumpy()
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack header + encoded image into one record string
+    (reference recordio.py:pack_img)."""
+    from .image import imencode
+
+    buf = imencode(img, quality=quality, img_fmt=img_fmt)
+    return pack(header, buf)

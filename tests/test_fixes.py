@@ -1,0 +1,102 @@
+"""Regression tests for review findings: initializer symmetry, per-mode
+cached aux, CTC loss, Constant serialization."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, initializer
+
+
+def test_no_symmetric_init():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=8))
+    net.add(gluon.nn.Dense(8, in_units=8))
+    net.initialize()
+    w0 = net[0].weight.data().asnumpy()
+    w1 = net[1].weight.data().asnumpy()
+    assert not np.allclose(w0, w1)
+
+
+def test_constant_initializer_roundtrip():
+    init = initializer.Constant(3.5)
+    arr = mx.nd.zeros((2, 2))
+    init("test_weight", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 3.5)
+
+
+def test_batchnorm_aux_after_mode_switch():
+    """BatchNorm running stats must keep updating after alternating
+    train/eval traces on a hybridized block."""
+    bn = gluon.nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    bn.hybridize()
+    x = mx.nd.array(np.random.rand(2, 4, 3, 3).astype(np.float32) * 5)
+    with mx.autograd.record():
+        bn(x)
+    rm1 = bn.running_mean.data().asnumpy().copy()
+    bn(x)  # eval trace
+    with mx.autograd.record():
+        bn(x)  # back to train: stats must still update
+    rm2 = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm1, rm2)
+
+
+def test_ctc_loss_values():
+    """Check against directly-computed likelihoods for a tiny case."""
+    loss_fn = gluon.loss.CTCLoss(layout="TNC", label_layout="NT")
+    T, N, C = 2, 1, 3  # blank = 2
+    pred = mx.nd.zeros((T, N, C))  # uniform: p = 1/3 each
+    label = mx.nd.array([[0, -1]])
+    out = loss_fn(pred, label).asnumpy()
+    # Paths for label 'a' in 2 frames: (a,a),(a,blank),(blank,a) = 3/9
+    expected = -np.log(3.0 / 9.0)
+    np.testing.assert_allclose(out, [expected], rtol=1e-5)
+
+
+def test_ctc_loss_batch_and_lengths():
+    loss_fn = gluon.loss.CTCLoss()
+    N, T, C = 3, 10, 5
+    pred = mx.nd.array(np.random.randn(N, T, C).astype(np.float32))
+    label = mx.nd.array([[1, 2, -1, -1], [0, 1, 2, 3], [2, -1, -1, -1]])
+    out = loss_fn(pred, label).asnumpy()
+    assert out.shape == (N,)
+    assert (out > 0).all()
+
+
+def test_ctc_loss_grad():
+    pred = mx.nd.array(np.random.randn(4, 2, 5).astype(np.float32))
+    pred.attach_grad()
+    label = mx.nd.array([[1, 2], [3, -1]])
+    loss_fn = gluon.loss.CTCLoss(layout="TNC")
+    with mx.autograd.record():
+        loss = loss_fn(pred, label).sum()
+    loss.backward()
+    g = pred.grad.asnumpy()
+    assert np.isfinite(g).all()
+    assert np.abs(g).sum() > 0
+
+
+def test_bias_initializer_respected():
+    net = gluon.nn.Dense(4, in_units=3, bias_initializer="ones")
+    net.initialize()
+    np.testing.assert_allclose(net.bias.data().asnumpy(), 1.0)
+
+
+def test_optimizer_count_single_step_multi_ctx():
+    net = gluon.nn.Dense(2, in_units=3)
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    net.initialize(ctx=ctxs)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    with mx.autograd.record():
+        losses = [(net(mx.nd.ones((2, 3), ctx=c)) ** 2).sum() for c in ctxs]
+    for l in losses:
+        l.backward()
+    trainer.step(4)
+    assert trainer._optimizer.num_update == 1
+
+
+def test_layernorm_scale_center_off():
+    ln = gluon.nn.LayerNorm(in_channels=4, scale=False, center=False)
+    ln.initialize()
+    assert ln.gamma.grad_req == "null"
+    assert ln.beta.grad_req == "null"

@@ -1,0 +1,192 @@
+"""Tests for io/recordio/metric (reference: tests/python/unittest/test_io.py,
+test_metric.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def test_ndarray_iter():
+    data = np.arange(1000).reshape((100, 10)).astype(np.float32)
+    label = np.arange(100).astype(np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=32, shuffle=False,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (32, 10)
+    assert batches[-1].pad == 28
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:32])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), label[:32])
+    # reset and re-iterate
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard_shuffle():
+    data = np.random.rand(100, 3).astype(np.float32)
+    it = mx.io.NDArrayIter(data, batch_size=30, shuffle=True,
+                           last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 3
+    assert all(b.pad == 0 for b in batches)
+
+
+def test_ndarray_iter_dict_input():
+    it = mx.io.NDArrayIter({"a": np.zeros((10, 2)), "b": np.ones((10, 3))},
+                           batch_size=5)
+    b = next(it)
+    names = sorted(d.name for d in b.provide_data)
+    assert names == ["a", "b"]
+
+
+def test_resize_iter():
+    data = np.zeros((20, 2), dtype=np.float32)
+    inner = mx.io.NDArrayIter(data, batch_size=5)
+    it = mx.io.ResizeIter(inner, 10)
+    assert len(list(it)) == 10
+
+
+def test_prefetching_iter():
+    data = np.arange(60).reshape((20, 3)).astype(np.float32)
+    inner = mx.io.NDArrayIter(data, batch_size=5)
+    it = mx.io.PrefetchingIter(inner)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        writer.write(b"record-%d" % i)
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert reader.read() == b"record-%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(10):
+        writer.write_idx(i, b"rec%d" % i)
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert reader.keys == list(range(10))
+    assert reader.read_idx(7) == b"rec7"
+    assert reader.read_idx(2) == b"rec2"
+    reader.close()
+
+
+def test_recordio_pack_unpack():
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 7 and payload == b"payload"
+    # vector label
+    header = recordio.IRHeader(0, np.array([1.0, 2.0], dtype=np.float32), 1, 0)
+    s = recordio.pack(header, b"xy")
+    h2, payload = recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1.0, 2.0])
+    assert payload == b"xy"
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(20, 4).astype(np.float32)
+    label = np.arange(20, dtype=np.float32).reshape(20, 1)
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    np.savetxt(data_path, data, delimiter=",")
+    np.savetxt(label_path, label, delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_path, data_shape=(4,),
+                       label_csv=label_path, batch_size=4)
+    b = next(it)
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:4], rtol=1e-5)
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=3)
+    b = next(it)
+    dense = b.data[0].asnumpy() if hasattr(b.data[0], "asnumpy") else b.data[0]
+    np.testing.assert_allclose(np.asarray(dense)[0], [1.5, 0, 0, 2.0])
+
+
+def test_metric_accuracy():
+    m = mx.metric.create("acc")
+    m.update([mx.nd.array([1, 0, 1])],
+             [mx.nd.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7]])])
+    assert m.get()[1] == 1.0
+    m.reset()
+    m.update([mx.nd.array([0, 0])], [mx.nd.array([[0.2, 0.8], [0.9, 0.1]])])
+    assert m.get()[1] == 0.5
+
+
+def test_metric_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.6, 0.3, 0.1]])
+    m.update([mx.nd.array([1, 2])], [pred])
+    assert m.get()[1] == 0.5
+
+
+def test_metric_composite_and_regression():
+    m = mx.metric.create(["acc", "mse", "mae"])
+    label = mx.nd.array([1, 0])
+    pred = mx.nd.array([[0.0, 1.0], [1.0, 0.0]])
+    # Accuracy sees argmax; MSE/MAE see raw values vs labels broadcast.
+    m.metrics[0].update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names[0]
+
+
+def test_metric_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[1.0, 0.0], [0.0, 1.0]])
+    m.update([mx.nd.array([0, 1])], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-5
+
+
+def test_metric_f1():
+    m = mx.metric.F1()
+    m.update([mx.nd.array([1, 0, 1, 1])],
+             [mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])])
+    assert 0 < m.get()[1] <= 1.0
+
+
+def test_custom_metric():
+    m = mx.metric.create(lambda label, pred: float(np.abs(label - pred).mean()))
+    m.update([mx.nd.array([1.0])], [mx.nd.array([0.5])])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_mnist_iter_synthetic(tmp_path):
+    """MNISTIter over synthetic IDX files (iter_mnist.cc format)."""
+    import struct
+
+    images = (np.random.rand(50, 28, 28) * 255).astype(np.uint8)
+    labels = np.random.randint(0, 10, 50).astype(np.uint8)
+    img_path = str(tmp_path / "images-idx3-ubyte")
+    lbl_path = str(tmp_path / "labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3))
+        f.write(struct.pack(">III", 50, 28, 28))
+        f.write(images.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 1))
+        f.write(struct.pack(">I", 50))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                         shuffle=False, flat=True)
+    b = next(it)
+    assert b.data[0].shape == (10, 784)
+    assert b.label[0].shape == (10,)

@@ -1,0 +1,111 @@
+"""KVStore tests (reference: tests/python/unittest/test_kvstore.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+
+
+def test_single_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+
+
+def test_aggregate_push():
+    kv = mx.kv.create("device")
+    kv.init("a", mx.nd.zeros(SHAPE))
+    devs = [mx.cpu(i) for i in range(4)]
+    vals = [mx.nd.ones(SHAPE, ctx=d) for d in devs]
+    kv.push("a", vals)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("a", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+
+
+def test_list_kv_pairs():
+    kv = mx.kv.create("local")
+    keys = [5, 7, 9]
+    kv.init(keys, [mx.nd.ones(SHAPE)] * 3)
+    kv.push(keys, [mx.nd.ones(SHAPE) * 2] * 3)
+    outs = [mx.nd.zeros(SHAPE) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), 2.0)
+
+
+def test_updater():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones(SHAPE))
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv.set_updater(updater)
+    kv.push("w", mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+    # aggregated push through updater
+    kv.push("w", [mx.nd.ones(SHAPE), mx.nd.ones(SHAPE)])
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 7.0)
+
+
+def test_optimizer_on_kvstore():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((2, 2)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(0, mx.nd.ones((2, 2)))
+    out = mx.nd.zeros((2, 2))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.1, rtol=1e-5)
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.arange(12).reshape(6, 2).astype(np.float32)
+    kv.init("emb", mx.nd.array(w))
+    out = mx.nd.zeros((6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([0, 2], dtype="int64"))
+    np.testing.assert_allclose(out.asnumpy(), w)
+
+
+def test_kvstore_types():
+    assert mx.kv.create("local").type == "local"
+    assert mx.kv.create("device").type == "device"
+    assert mx.kv.create("nccl").type == "device"
+    with pytest.raises(ValueError):
+        mx.kv.create("bogus")
+
+
+def test_trainer_multi_device_step():
+    """Data-parallel trainer update across 4 virtual devices."""
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(2, in_units=3)
+    ctxs = [mx.cpu(i) for i in range(4)]
+    net.initialize(ctx=ctxs)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5}, kvstore="device")
+    data = [mx.nd.ones((2, 3), ctx=c) for c in ctxs]
+    with mx.autograd.record():
+        losses = []
+        for x in data:
+            out = net(x)
+            losses.append((out * out).sum())
+    for l in losses:
+        l.backward()
+    w_before = net.weight.data(ctxs[0]).asnumpy()
+    trainer.step(batch_size=8)
+    w_after = [net.weight.data(c).asnumpy() for c in ctxs]
+    # all replicas identical after allreduce+update
+    for w in w_after[1:]:
+        np.testing.assert_allclose(w, w_after[0], rtol=1e-5)
+    assert not np.allclose(w_before, w_after[0])
