@@ -1,0 +1,63 @@
+"""Benchmark: ResNet-50 v1 ImageNet-shape training throughput, single
+chip — the reference's headline number (docs/faq/perf.md:214: 298.51
+img/s, batch 32, fp32, 1x V100; BASELINE.md).
+
+Whole training step (fwd + softmax CE + bwd + SGD-momentum update)
+compiled as one XLA executable via mxnet_tpu.parallel.TrainStep.
+Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 298.51  # docs/faq/perf.md:214 (b=32 fp32 V100)
+BATCH = 32
+WARMUP = 3
+WINDOWS = 5   # median-of-windows is robust to shared-chip contention
+ITERS = 10    # steps per window
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import TrainStep, make_mesh
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                       "wd": 1e-4},
+                     mesh=make_mesh({"dp": 1}, devices=jax.devices()[:1]))
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(BATCH, 3, 224, 224).astype(np.float32)
+    y = rng.randint(0, 1000, BATCH).astype(np.float32)
+
+    for _ in range(WARMUP):
+        loss = step(x, y)
+    jax.block_until_ready(loss)
+
+    rates = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = step(x, y)
+        jax.block_until_ready(loss)
+        rates.append(BATCH * ITERS / (time.perf_counter() - t0))
+    img_s = sorted(rates)[len(rates) // 2]
+    print(json.dumps({
+        "metric": "resnet50_v1_train_img_per_sec_b32",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

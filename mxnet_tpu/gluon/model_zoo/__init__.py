@@ -1,0 +1,6 @@
+"""Model zoo (reference: python/mxnet/gluon/model_zoo/ — vision models +
+pinned pretrained weights via model_store.py)."""
+from . import vision
+from .vision import get_model
+
+__all__ = ["vision", "get_model"]

@@ -1,0 +1,25 @@
+"""Pretrained weight store (reference:
+python/mxnet/gluon/model_zoo/model_store.py — sha1-pinned weight files
+fetched from the MXNet S3 bucket).
+
+This environment has no network egress, so pretrained weights must be
+provided locally: set MXNET_TPU_MODEL_ZOO_DIR to a directory of
+`<model_name>.params` files saved by `Block.save_parameters`.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file"]
+
+
+def get_model_file(name, root=None):
+    root = root or os.environ.get("MXNET_TPU_MODEL_ZOO_DIR",
+                                  os.path.expanduser("~/.mxnet_tpu/models"))
+    path = os.path.join(root, name + ".params")
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        "Pretrained weights for %r not found at %s. This build cannot "
+        "download weights (no network); place a .params file there "
+        "(Block.save_parameters format) or use pretrained=False." % (name, path))

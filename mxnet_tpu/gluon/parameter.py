@@ -210,8 +210,8 @@ class Parameter:
         if self._data is None:
             if self._deferred_init is not None:
                 self.shape = tuple(data.shape)
-                init, ctx = self._deferred_init
-                self._finish_init(init, ctx)
+                init, ctx, specific = self._deferred_init
+                self._finish_init(init, ctx, specific)
             else:
                 raise RuntimeError("Parameter '%s' not initialized" % self.name)
         for c, d in self._data.items():

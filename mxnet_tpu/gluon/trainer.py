@@ -127,9 +127,13 @@ class Trainer:
                 continue
             datas, grads = p.list_data(), p.list_grad()
             # After _allreduce_grads all replicas hold the merged
-            # gradient, so updating replica 0 and broadcasting is
-            # equivalent to the server-side update.
-            self._updater(i, grads[0], datas[0])
+            # gradient; without a kvstore (kvstore=None) merge locally so
+            # replicas 1..N are not silently dropped.
+            grad = grads[0]
+            if len(grads) > 1 and self._kvstore is None:
+                for g in grads[1:]:
+                    grad = grad + g.as_in_context(grad.context)
+            self._updater(i, grad, datas[0])
             for d in datas[1:]:
                 d[:] = datas[0].as_in_context(d.context)
 

@@ -1,0 +1,7 @@
+"""mx.image — image IO + augmentation (reference: python/mxnet/image/).
+"""
+from .image import *  # noqa: F401,F403
+from . import image  # noqa: F401
+from .detection import *  # noqa: F401,F403
+
+__all__ = image.__all__

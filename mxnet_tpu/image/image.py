@@ -1,0 +1,590 @@
+"""Image IO and augmenters.
+
+Reference: python/mxnet/image/image.py (imdecode/imread/imresize
+:493-700, python augmenters, ImageIter) and the C++ pipeline
+src/io/iter_image_recordio_2.cc (chunked RecordIO + parallel JPEG
+decode + per-thread augmenters) / src/operator/image/image_io.cc.
+
+TPU rebuild: decode and augment run host-side via OpenCV (the
+reference's backend too); the augmented batch moves to HBM once. The
+high-throughput path wraps this in a background PrefetchingIter so host
+decode overlaps device compute (ImageRecordIterImpl below; the C++
+runtime in src/ supplies a native multithreaded variant).
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+
+import numpy as np
+
+from ..ndarray.ndarray import NDArray, array as nd_array
+from .. import io as mxio
+from .. import recordio
+
+__all__ = ["imread", "imdecode", "imencode", "imresize", "scale_down",
+           "resize_short", "fixed_crop", "random_crop", "center_crop",
+           "color_normalize", "random_size_crop",
+           "Augmenter", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "HueJitterAug", "ColorJitterAug", "LightingAug",
+           "ColorNormalizeAug", "RandomGrayAug", "HorizontalFlipAug",
+           "CastAug", "CreateAugmenter", "ImageIter", "ImageRecordIterImpl"]
+
+
+def _cv2():
+    import cv2
+
+    return cv2
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to HWC uint8 (reference image.py:imdecode
+    / image_io.cc). to_rgb converts BGR->RGB like the reference."""
+    cv2 = _cv2()
+    if isinstance(buf, (bytes, bytearray)):
+        buf = np.frombuffer(buf, dtype=np.uint8)
+    elif isinstance(buf, NDArray):
+        buf = buf.asnumpy().astype(np.uint8)
+    img = cv2.imdecode(buf, int(flag))
+    if img is None:
+        raise ValueError("Decoding failed: invalid image data")
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return nd_array(img)
+
+
+def imencode(img, quality=95, img_fmt=".jpg"):
+    """Encode HWC image to bytes (used by recordio.pack_img)."""
+    cv2 = _cv2()
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = np.asarray(img)
+    params = [cv2.IMWRITE_JPEG_QUALITY, int(quality)] \
+        if img_fmt.lower() in (".jpg", ".jpeg") else []
+    ok, buf = cv2.imencode(img_fmt, img, params)
+    if not ok:
+        raise ValueError("Encoding failed")
+    return buf.tobytes()
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read and decode an image file (reference image.py:imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize to (w, h) (reference image.py:imresize)."""
+    cv2 = _cv2()
+    img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    return nd_array(cv2.resize(img, (w, h), interpolation=int(interp)))
+
+
+def scale_down(src_size, size):
+    """Scale target size down to fit src (reference image.py:scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge = size (reference image.py:resize_short)."""
+    img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(img, new_w, new_h, interp=interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp=interp)
+    return nd_array(out)
+
+
+def random_crop(src, size, interp=2):
+    img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random crop with area/aspect constraints (inception-style,
+    reference image.py:random_size_crop)."""
+    img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(img, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    img = src.asnumpy().astype(np.float32) if isinstance(src, NDArray) \
+        else np.asarray(src, dtype=np.float32)
+    if mean is not None:
+        img = img - np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        img = img / np.asarray(std, dtype=np.float32)
+    return nd_array(img)
+
+
+# -- Augmenters (reference image.py:Augmenter hierarchy) ---------------------
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        pyrandom.shuffle(self.ts)
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        return nd_array(img.astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        img = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return nd_array(img * alpha + gray.mean() * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        img = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (reference image.py:HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], dtype=np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      dtype=np.float32)
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        img = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        return nd_array(np.dot(img, t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (AlexNet-style, reference image.py:LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, dtype=np.float32)
+        self.eigvec = np.asarray(eigvec, dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        img = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        return nd_array(img + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _mat = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], dtype=np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            img = (src.asnumpy() if isinstance(src, NDArray)
+                   else np.asarray(src)).astype(np.float32)
+            return nd_array(np.dot(img, self._mat))
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+            return nd_array(img[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        return nd_array(img.astype(self.typ))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmentation pipeline factory (reference
+    image.py:CreateAugmenter; C++ defaults image_aug_default.cc)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and np.asarray(mean).shape[0] > 0 or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(mxio.DataIter):
+    """Image iterator over .rec files or an image list + directory, with
+    python augmenters (reference image.py:ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx,
+                                                         path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+            self.imglist = None
+        else:
+            self.imgrec = None
+            if path_imglist:
+                with open(path_imglist) as fin:
+                    imglist = {}
+                    imgkeys = []
+                    for line in iter(fin.readline, ""):
+                        line = line.strip().split("\t")
+                        label = np.array(line[1:-1], dtype=np.float32)
+                        key = int(line[0])
+                        imglist[key] = (label, line[-1])
+                        imgkeys.append(key)
+                    self.imglist = imglist
+                    self.imgidx = imgkeys
+            else:
+                result = {}
+                imgkeys = []
+                for i, img in enumerate(imglist):
+                    key = str(i)
+                    label = np.array(img[0], dtype=np.float32) \
+                        if not isinstance(img[0], (int, float)) \
+                        else np.array([img[0]], dtype=np.float32)
+                    result[key] = (label, img[1])
+                    imgkeys.append(key)
+                self.imglist = result
+                self.imgidx = imgkeys
+        self.path_root = path_root
+        self.shuffle = shuffle
+        self.seq = self.imgidx
+        if num_parts > 1 and self.seq is not None:
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [mxio.DataDesc(self.data_name,
+                              (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [mxio.DataDesc(self.label_name,
+                              (self.batch_size, self.label_width)
+                              if self.label_width > 1
+                              else (self.batch_size,))]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Return (label, decoded image ndarray)."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, imdecode(img)
+            label, fname = self.imglist[idx]
+            return label, imread(os.path.join(self.path_root or "", fname))
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, imdecode(img)
+
+    def next(self):
+        batch_data = np.zeros((self.batch_size,) + self.data_shape,
+                              dtype=np.float32)
+        shape = (self.batch_size, self.label_width) if self.label_width > 1 \
+            else (self.batch_size,)
+        batch_label = np.zeros(shape, dtype=np.float32)
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            try:
+                label, img = self.next_sample()
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+            batch_data[i] = arr.transpose(2, 0, 1)  # HWC -> CHW
+            batch_label[i] = np.asarray(label, dtype=np.float32).reshape(
+                batch_label[i].shape) if self.label_width > 1 else float(
+                np.asarray(label).ravel()[0])
+            i += 1
+        return mxio.DataBatch(data=[nd_array(batch_data)],
+                              label=[nd_array(batch_label)], pad=pad,
+                              provide_data=self.provide_data,
+                              provide_label=self.provide_label)
+
+
+def ImageRecordIterImpl(path_imgrec=None, data_shape=(3, 224, 224),
+                        batch_size=128, shuffle=False, preprocess_threads=4,
+                        prefetch_buffer=4, path_imgidx=None, mean_r=0.0,
+                        mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                        std_b=1.0, rand_crop=False, rand_mirror=False,
+                        resize=0, **kwargs):
+    """Factory behind mx.io.ImageRecordIter: ImageIter + background
+    prefetch (reference C++ path: PrefetcherIter(BatchLoader(
+    ImageRecordIOParser2)), iter_image_recordio_2.cc)."""
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b])
+    std = None
+    if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+        std = np.array([std_r, std_g, std_b])
+    inner = ImageIter(batch_size=batch_size, data_shape=tuple(data_shape),
+                      path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                      shuffle=shuffle, rand_crop=rand_crop,
+                      rand_mirror=rand_mirror, resize=resize,
+                      mean=mean, std=std,
+                      **{k: v for k, v in kwargs.items()
+                         if k in ("label_width", "aug_list", "num_parts",
+                                  "part_index", "brightness", "contrast",
+                                  "saturation", "hue", "pca_noise",
+                                  "rand_gray", "rand_resize")})
+    return mxio.PrefetchingIter(inner)

@@ -228,7 +228,11 @@ def create(name="local"):
     if name in ("device", "local_allreduce_device", "nccl"):
         return KVStoreLocal(device_mode=True)
     if name.startswith("dist"):
-        from .kvstore_dist import KVStoreDist
-
+        try:
+            from .kvstore_dist import KVStoreDist
+        except ImportError as e:
+            raise NotImplementedError(
+                "kvstore %r requires the multi-host backend "
+                "(mxnet_tpu.kvstore_dist): %s" % (name, e)) from None
         return KVStoreDist(name)
     raise ValueError("unknown kvstore type %r" % name)

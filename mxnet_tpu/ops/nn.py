@@ -301,6 +301,9 @@ def _activation(data, act_type="relu"):
         return nn.softplus(data)
     if act_type == "softsign":
         return data / (1 + jnp.abs(data))
+    if act_type == "relu6":
+        # MobileNet family (reference: clip(relu(x), 0, 6) via mshadow_op).
+        return jnp.clip(data, 0, 6)
     raise ValueError("unknown act_type %s" % act_type)
 
 

@@ -1,0 +1,25 @@
+"""SPMD parallelism over a device mesh.
+
+Reference coverage (SURVEY.md §2.3): the reference's complete
+parallelism story is data parallelism via kvstore reduction trees /
+NCCL rings (src/kvstore/comm.h, kvstore_nccl.h), model-group placement
+(group2ctx), and the ps-lite parameter server for multi-node. The
+TPU-native equivalents here subsume all three:
+
+- `make_mesh` builds a `jax.sharding.Mesh` with named axes
+  (dp/tp/sp/ep/pp) over the chips; XLA schedules collectives on the ICI
+  torus (replacing comm_tree.h's PCIe topology search).
+- `TrainStep` compiles forward+loss+backward+optimizer-update into ONE
+  XLA executable with sharded inputs: the gradient all-reduce is not a
+  separate kvstore round-trip but a `psum` XLA fuses into the step
+  (overlapping backward compute with gradient reduction — what the
+  reference gets from engine priority hints, threaded_engine_perdevice).
+- Sequence parallelism / ring attention for long context lives in
+  `ring_attention.py` (the reference has none — SURVEY.md §5.7; this is
+  TPU-first new capability).
+"""
+from .mesh import make_mesh, data_sharding, replicate, shard_params
+from .train_step import TrainStep
+
+__all__ = ["make_mesh", "data_sharding", "replicate", "shard_params",
+           "TrainStep"]

@@ -1,0 +1,74 @@
+"""Device mesh construction + sharding rules.
+
+The mesh axes follow the scaling-book convention: dp (data), tp (tensor/
+model), sp (sequence), ep (expert), pp (pipeline stage). Any subset may
+be present; axis size 1 is always legal, so the same code runs from one
+chip to a pod slice.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_sharding", "replicate", "shard_params",
+           "P", "NamedSharding"]
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from `axes` = dict name->size (in order). Sizes must
+    multiply to the device count; a -1 size is inferred.
+
+    >>> mesh = make_mesh({"dp": -1})                   # pure data parallel
+    >>> mesh = make_mesh({"dp": 4, "tp": 2})           # 2-way tensor model
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    names = list(axes)
+    sizes = [axes[a] for a in names]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        assert n % known == 0, "cannot infer axis size: %d devices / %s" % (
+            n, axes)
+        sizes = [n // known if s == -1 else s for s in sizes]
+    assert int(np.prod(sizes)) == n, \
+        "mesh %s does not cover %d devices" % (dict(zip(names, sizes)), n)
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def data_sharding(mesh, batch_axes=("dp",)):
+    """Sharding for a [batch, ...] array: batch split over the data axes
+    present in the mesh, rest replicated."""
+    axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    spec = P(axes if axes else None)
+    return NamedSharding(mesh, spec)
+
+
+def replicate(mesh):
+    return NamedSharding(mesh, P())
+
+
+def _default_param_rule(name, shape, mesh):
+    """Megatron-style tensor parallelism for 2D weights when a tp axis
+    exists: shard the output-features dim of large matmuls; replicate
+    everything else. Biases/BN stay replicated."""
+    if "tp" not in mesh.axis_names or mesh.shape["tp"] == 1:
+        return P()
+    tp = mesh.shape["tp"]
+    if len(shape) == 2 and shape[0] % tp == 0 and min(shape) >= 2 * tp:
+        return P("tp", None)
+    if len(shape) == 4 and shape[0] % tp == 0 and shape[0] >= 4 * tp:
+        return P("tp", None, None, None)  # conv out-channels
+    return P()
+
+
+def shard_params(mesh, named_shapes, rule=None):
+    """Map {name: shape} -> {name: NamedSharding} with `rule(name, shape,
+    mesh) -> PartitionSpec` (default: Megatron-ish tp rule)."""
+    rule = rule or _default_param_rule
+    return {name: NamedSharding(mesh, rule(name, shape, mesh))
+            for name, shape in named_shapes.items()}

@@ -1,0 +1,239 @@
+"""Whole-step SPMD compilation: forward + loss + backward + optimizer
+update as ONE XLA executable over a device mesh.
+
+This is the TPU-blessed training path (SURVEY.md §7 "hard parts":
+per-op dispatch is µs-scale in the reference's engine but ms-scale for
+XLA launches, so the imperative Trainer loop can never reach reference
+throughput — compiling the whole step can and does). Equivalent
+reference machinery: GraphExecutor's fwd+bwd graph with bulked segments
+(graph_executor.cc:1186) + kvstore push/pull, here fused so the
+gradient all-reduce (psum XLA inserts for the sharded-batch mean loss)
+overlaps backward compute on the ICI.
+
+Buffer donation of params/optimizer state gives in-place updates (the
+engine-var mutation semantics of the reference, expressed as XLA
+aliasing).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import random as _random
+from ..ndarray.ndarray import NDArray
+from ..gluon.parameter import override
+from .mesh import make_mesh, data_sharding, replicate, shard_params, \
+    NamedSharding, P
+
+__all__ = ["TrainStep"]
+
+
+def _sgd_update(param, grad, state, lr, momentum, wd, rescale):
+    g = grad.astype(jnp.float32) * rescale + wd * param.astype(jnp.float32)
+    if momentum > 0:
+        mom = state * momentum - lr * g
+        return (param + mom.astype(param.dtype)), mom
+    return (param - (lr * g).astype(param.dtype)), state
+
+
+def _adam_update(param, grad, state, lr, t, beta1, beta2, epsilon, wd,
+                 rescale):
+    mean, var = state
+    g = grad.astype(jnp.float32) * rescale + wd * param.astype(jnp.float32)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * g * g
+    lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    step = lr_t * mean / (jnp.sqrt(var) + epsilon)
+    return (param - step.astype(param.dtype)), (mean, var)
+
+
+class TrainStep:
+    """Compile `net` + `loss_fn` + optimizer into one sharded step.
+
+    Parameters
+    ----------
+    net : initialized gluon Block (params live on one context; TrainStep
+        takes ownership of the values and shards them over the mesh).
+    loss_fn : callable (pred NDArray, label NDArray) -> per-sample loss.
+    optimizer : 'sgd' (momentum/wd) or 'adam'.
+    optimizer_params : dict — learning_rate, momentum, wd, beta1/2, ...
+        learning_rate is a *runtime input* to the executable, so LR
+        schedules don't retrace.
+    mesh : jax Mesh (default: all devices on one 'dp' axis).
+    param_rule : callable(name, shape, mesh) -> PartitionSpec for tensor
+        parallelism (default Megatron-ish rule in mesh.shard_params).
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, param_rule=None, dtype=None):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else make_mesh()
+        opt_params = dict(optimizer_params or {})
+        self.lr = float(opt_params.pop("learning_rate", 0.01))
+        self.optimizer = optimizer
+        self.momentum = float(opt_params.pop("momentum", 0.0))
+        # Defaults match mxnet_tpu.optimizer.Optimizer so Trainer and
+        # TrainStep train identically on the same optimizer_params.
+        self.wd = float(opt_params.pop("wd", 0.0))
+        self.beta1 = float(opt_params.pop("beta1", 0.9))
+        self.beta2 = float(opt_params.pop("beta2", 0.999))
+        self.epsilon = float(opt_params.pop("epsilon", 1e-8))
+        self.rescale_grad = float(opt_params.pop("rescale_grad", 1.0))
+        clip = opt_params.pop("clip_gradient", None)
+        self.clip_gradient = None if clip is None else float(clip)
+        if opt_params:
+            raise ValueError("TrainStep got unsupported optimizer_params %s"
+                             % sorted(opt_params))
+        self.num_update = 0
+
+        self._dtype = dtype
+        self._param_rule = param_rule
+        self._jitted = None
+        self._materialized = False
+
+    def _materialize(self, x_example):
+        """Collect param values (triggering deferred init with a real
+        forward if needed) and lay them out on the mesh."""
+        net, optimizer, dtype = self.net, self.optimizer, self._dtype
+        params = list(net.collect_params().values())
+        if any(p._data is None and p._deferred_init is not None
+               for p in params):
+            with autograd.pause():
+                net(NDArray(jnp.asarray(x_example)))
+            params = list(net.collect_params().values())
+        self._train_params = [p for p in params if p.grad_req != "null"]
+        self._aux_params = [p for p in params if p.grad_req == "null"]
+        get = lambda p: p.data()._data if dtype is None else \
+            p.data()._data.astype(dtype)
+        self._param_vals = {p.name: get(p) for p in self._train_params}
+        self._aux_vals = {p.name: p.data()._data for p in self._aux_params}
+
+        # Optimizer state mirrors param sharding (ZeRO-0; the state is
+        # sharded exactly like its weight so updates are local).
+        if optimizer == "sgd":
+            self._opt_state = {n: jnp.zeros_like(v, dtype=jnp.float32)
+                               for n, v in self._param_vals.items()}
+        elif optimizer == "adam":
+            self._opt_state = {n: (jnp.zeros_like(v, dtype=jnp.float32),
+                                   jnp.zeros_like(v, dtype=jnp.float32))
+                               for n, v in self._param_vals.items()}
+        else:
+            raise ValueError("TrainStep supports 'sgd' and 'adam'; for other "
+                             "optimizers use gluon.Trainer")
+
+        self._shardings = shard_params(
+            self.mesh, {n: v.shape for n, v in self._param_vals.items()},
+            rule=self._param_rule)
+        self._data_sharding = data_sharding(self.mesh)
+        self._repl = replicate(self.mesh)
+
+        # Place params/aux/state according to the sharding plan.
+        self._param_vals = {n: jax.device_put(v, self._shardings[n])
+                            for n, v in self._param_vals.items()}
+        self._aux_vals = {n: jax.device_put(v, self._repl)
+                          for n, v in self._aux_vals.items()}
+        if optimizer == "adam":
+            self._opt_state = {
+                n: tuple(jax.device_put(s, self._shardings[n]) for s in st)
+                for n, st in self._opt_state.items()}
+        else:
+            self._opt_state = {n: jax.device_put(v, self._shardings[n])
+                               for n, v in self._opt_state.items()}
+        self._materialized = True
+
+    # -- the pure step --------------------------------------------------------
+
+    def _build(self):
+        net, loss_fn = self.net, self.loss_fn
+        train_params = self._train_params
+        aux_params = self._aux_params
+        optimizer = self.optimizer
+        momentum, wd = self.momentum, self.wd
+        beta1, beta2, epsilon = self.beta1, self.beta2, self.epsilon
+        rescale = self.rescale_grad
+
+        def loss_of(pvals, aux_vals, x, y, key):
+            mapping = {p: NDArray(pvals[p.name]) for p in train_params}
+            mapping.update({p: NDArray(aux_vals[p.name]) for p in aux_params})
+            ov = override(mapping)
+            with autograd.pause(train_mode=True), \
+                    _random.trace_key_scope(key), ov:
+                out = net(NDArray(x))
+                loss = loss_fn(out, NDArray(y))
+            new_aux = dict(aux_vals)
+            for p, v in ov.writes.items():
+                new_aux[p.name] = v._data if isinstance(v, NDArray) else v
+            return jnp.mean(loss._data), new_aux
+
+        clip = self.clip_gradient
+
+        def step(pvals, opt_state, aux_vals, x, y, lr, t, key):
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(pvals, aux_vals, x, y, key)
+            new_p, new_s = {}, {}
+            for name, p in pvals.items():
+                g = grads[name]
+                if clip is not None:
+                    # Elementwise clip after rescale, matching
+                    # Optimizer.clip_gradient semantics (optimizer.py).
+                    g = jnp.clip(g * rescale, -clip, clip) / rescale
+                if optimizer == "sgd":
+                    new_p[name], new_s[name] = _sgd_update(
+                        p, g, opt_state[name], lr, momentum, wd, rescale)
+                else:
+                    new_p[name], new_s[name] = _adam_update(
+                        p, g, opt_state[name], lr, t, beta1, beta2, epsilon,
+                        wd, rescale)
+            return new_p, new_s, new_aux, loss
+
+        shardings = self._shardings
+        state_shardings = {n: (shardings[n] if optimizer == "sgd"
+                               else (shardings[n], shardings[n]))
+                           for n in shardings}
+        aux_shardings = {p.name: self._repl for p in aux_params}
+        in_shardings = (shardings, state_shardings, aux_shardings,
+                        self._data_sharding, self._data_sharding,
+                        self._repl, self._repl, self._repl)
+        out_shardings = (shardings, state_shardings, aux_shardings,
+                         self._repl)
+        self._jitted = jax.jit(step, in_shardings=in_shardings,
+                               out_shardings=out_shardings,
+                               donate_argnums=(0, 1, 2))
+
+    # -- public API -----------------------------------------------------------
+
+    def __call__(self, x, y):
+        """Run one training step; returns the (host) scalar loss."""
+        if isinstance(x, NDArray):
+            x = x._data
+        if isinstance(y, NDArray):
+            y = y._data
+        if not self._materialized:
+            self._materialize(np.asarray(x)[:1])
+        if self._jitted is None:
+            self._build()
+        x = jax.device_put(jnp.asarray(x), self._data_sharding)
+        y = jax.device_put(jnp.asarray(y), self._data_sharding)
+        self.num_update += 1
+        key = _random.next_key()
+        (self._param_vals, self._opt_state, self._aux_vals,
+         loss) = self._jitted(self._param_vals, self._opt_state,
+                              self._aux_vals, x, y,
+                              jnp.float32(self.lr),
+                              jnp.float32(self.num_update), key)
+        return loss
+
+    def set_learning_rate(self, lr):
+        self.lr = float(lr)
+
+    def sync_to_net(self):
+        """Copy the (possibly sharded) param values back into the net's
+        Parameters (gather happens lazily on host read)."""
+        for p in self._train_params:
+            p.set_data(NDArray(self._param_vals[p.name]))
+        for p in self._aux_params:
+            p.set_data(NDArray(self._aux_vals[p.name]))

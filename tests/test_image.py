@@ -1,0 +1,103 @@
+"""Image module tests (reference: tests/python/unittest/test_image.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+
+
+@pytest.fixture
+def rgb():
+    return (np.random.rand(40, 60, 3) * 255).astype(np.uint8)
+
+
+def test_imencode_imdecode_roundtrip(rgb):
+    buf = image.imencode(rgb, img_fmt=".png")
+    dec = image.imdecode(buf, to_rgb=False).asnumpy()
+    np.testing.assert_array_equal(dec, rgb)
+
+
+def test_pack_unpack_img(rgb):
+    s = recordio.pack_img(recordio.IRHeader(0, 2.0, 1, 0), rgb,
+                          img_fmt=".png")
+    h, im2 = recordio.unpack_img(s)
+    assert h.label == 2.0
+    assert im2.shape == (40, 60, 3)
+
+
+def test_resize_and_crops(rgb):
+    r = image.resize_short(rgb, 32)
+    assert min(r.shape[:2]) == 32
+    c, rect = image.center_crop(rgb, (24, 24))
+    assert c.shape[:2] == (24, 24)
+    c2, _ = image.random_crop(rgb, (16, 16))
+    assert c2.shape[:2] == (16, 16)
+
+
+def test_augmenter_pipeline(rgb):
+    augs = image.CreateAugmenter((3, 32, 32), rand_crop=True,
+                                 rand_mirror=True, brightness=0.1,
+                                 contrast=0.1, saturation=0.1, hue=0.1,
+                                 pca_noise=0.05, rand_gray=0.1,
+                                 mean=True, std=True)
+    out = rgb
+    for a in augs:
+        out = a(out)
+    assert out.shape == (32, 32, 3)
+
+
+def test_image_record_iter(tmp_path):
+    rec_path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(12):
+        im = (np.random.rand(50, 50, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), im, img_fmt=".jpg"))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                               data_shape=(3, 32, 32), batch_size=4,
+                               shuffle=True, rand_mirror=True)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert b.label[0].shape == (4,)
+
+
+def test_image_iter_list(tmp_path):
+    import cv2
+
+    files = []
+    for i in range(6):
+        p = str(tmp_path / ("img%d.jpg" % i))
+        cv2.imwrite(p, (np.random.rand(50, 50, 3) * 255).astype(np.uint8))
+        files.append((i % 2, "img%d.jpg" % i))
+    it = image.ImageIter(batch_size=3, data_shape=(3, 32, 32),
+                         imglist=files, path_root=str(tmp_path))
+    b = next(it)
+    assert b.data[0].shape == (3, 3, 32, 32)
+
+
+def test_detection_augmenters(rgb):
+    from mxnet_tpu.image import CreateDetAugmenter
+
+    label = np.array([[1, 0.1, 0.1, 0.6, 0.7]])
+    dets = CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True)
+    im3, lab3 = rgb, label
+    for a in dets:
+        im3, lab3 = a(im3, lab3)
+    arr = im3.asnumpy() if hasattr(im3, "asnumpy") else np.asarray(im3)
+    assert arr.shape[:2] == (32, 32)
+    assert lab3.shape[1] == 5
+
+
+def test_set_data_on_deferred_param():
+    """Regression: set_data on a deferred-init parameter (3-tuple)."""
+    from mxnet_tpu import gluon
+
+    d = gluon.nn.Dense(10)
+    d.initialize()
+    d.weight.set_data(mx.nd.array(np.zeros((10, 5), dtype=np.float32)))
+    assert d.weight.data().shape == (10, 5)

@@ -1,0 +1,70 @@
+"""Model zoo forward tests (reference: tests/python/unittest/
+test_gluon_model_zoo.py — forward each model on a small batch)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+# One representative per family at standard resolution (the nightly
+# covers all variants; keep unit runtime bounded).
+SMALL_MODELS = [
+    ("resnet18_v1", (1, 3, 32, 32), True),
+    ("resnet18_v2", (1, 3, 32, 32), True),
+    ("mobilenet0.25", (1, 3, 32, 32), False),
+    ("mobilenetv2_0.25", (1, 3, 32, 32), False),
+]
+
+BIG_MODELS = [
+    ("resnet50_v1", (1, 3, 224, 224)),
+    ("vgg11", (1, 3, 224, 224)),
+    ("alexnet", (1, 3, 224, 224)),
+    ("squeezenet1.1", (1, 3, 224, 224)),
+    ("densenet121", (1, 3, 224, 224)),
+    ("inceptionv3", (1, 3, 299, 299)),
+]
+
+
+@pytest.mark.parametrize("name,shape,thumbnail", SMALL_MODELS)
+def test_small_models_forward(name, shape, thumbnail):
+    kwargs = {"classes": 10}
+    if thumbnail:
+        kwargs["thumbnail"] = True
+    net = vision.get_model(name, **kwargs)
+    net.initialize()
+    out = net(mx.nd.array(np.random.rand(*shape).astype(np.float32)))
+    assert out.shape == (shape[0], 10)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+@pytest.mark.parametrize("name,shape", BIG_MODELS)
+def test_big_models_construct_and_forward(name, shape):
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    out = net(mx.nd.array(np.random.rand(*shape).astype(np.float32)))
+    assert out.shape == (shape[0], 10)
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        vision.get_model("resnet999_v9")
+
+
+def test_resnet50_hybridize_train_step():
+    """Flagship model: hybridized forward+backward trains."""
+    from mxnet_tpu import gluon
+
+    net = vision.resnet50_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(np.random.rand(2, 3, 224, 224).astype(np.float32))
+    y = mx.nd.array(np.array([1, 2], dtype=np.float32))
+    with mx.autograd.record():
+        out = net(x)
+        loss = loss_fn(out, y)
+    loss.backward()
+    trainer.step(2)
+    assert np.isfinite(loss.asnumpy()).all()
