@@ -1,0 +1,67 @@
+"""Model checkpoint helpers + BatchEndParam.
+
+Reference: python/mxnet/model.py (save_checkpoint/load_checkpoint
+:383-413, BatchEndParam, _create_kvstore)."""
+from __future__ import annotations
+
+from collections import namedtuple
+
+from . import ndarray as nd
+from . import symbol as sym
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "load_params"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write `prefix-symbol.json` and `prefix-%04d.params` (reference
+    model.py:save_checkpoint; same file layout)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+
+
+def load_params(prefix, epoch):
+    """(reference model.py:load_params)."""
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns (symbol, arg_params, aux_params) (reference
+    model.py:load_checkpoint)."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """(reference model.py:_create_kvstore). Returns (kv,
+    update_on_kvstore)."""
+    from . import kvstore as kvs
+
+    if kvstore is None:
+        return None, False
+    if isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            return None, False
+        kv = kvs.create(kvstore)
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    return kv, True

@@ -1,0 +1,365 @@
+"""Module — symbolic training over one or more devices.
+
+Reference: python/mxnet/module/module.py (bind :364 creates
+DataParallelExecutorGroup over per-device simple_binds; init_optimizer
+:474 creates kvstore via model._create_kvstore; update :644 pushes/pulls
+grads through the kvstore).
+
+TPU rebuild: one Executor per context; the batch is sliced across
+contexts (executor_group.py:_split_input_slice semantics). For a single
+context (the common TPU case — SPMD sharding replaces multi-executor
+data parallelism), this is one whole-graph XLA executable. Gradient
+reduction across contexts rides the kvstore (XLA collectives /
+host merge).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..model import _create_kvstore, load_checkpoint
+from ..ndarray.ndarray import NDArray
+from .base_module import BaseModule, _as_list
+
+
+def _split_slices(batch_size, num_parts):
+    """(reference executor_manager.py:_split_input_slice)."""
+    step = (batch_size + num_parts - 1) // num_parts
+    slices = []
+    for i in range(num_parts):
+        lo = min(i * step, batch_size)
+        hi = min((i + 1) * step, batch_size)
+        slices.append(slice(lo, hi))
+    return slices
+
+
+class Module(BaseModule):
+    """(reference module.py:Module)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = [ctx_mod.current_context()]
+        if isinstance(context, ctx_mod.Context):
+            context = [context]
+        self._context = context
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._execs = []
+        self._data_shapes = None
+        self._label_shapes = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._optimizer = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._grad_req = "write"
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self._output_names, self._execs[0].outputs)] \
+            if self._execs and self._execs[0].outputs else None
+
+    # -- bind -----------------------------------------------------------------
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """(reference module.py:bind :364)."""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self._grad_req = grad_req if for_training else "null"
+        self._data_shapes = [d if isinstance(d, tuple) else tuple(d)
+                             for d in [(getattr(d, "name", d[0]),
+                                        tuple(getattr(d, "shape", d[1])))
+                                       for d in data_shapes]]
+        if label_shapes:
+            self._label_shapes = [(getattr(l, "name", l[0]),
+                                   tuple(getattr(l, "shape", l[1])))
+                                  for l in label_shapes]
+        else:
+            self._label_shapes = None
+
+        n_dev = len(self._context)
+        batch_axis_sizes = {}
+        shape_map = {}
+        for name, shape in self._data_shapes + (self._label_shapes or []):
+            shape_map[name] = shape
+        self._batch_size = self._data_shapes[0][1][0]
+        slices = _split_slices(self._batch_size, n_dev)
+        self._slices = slices
+
+        self._execs = []
+        for i, c in enumerate(self._context):
+            dev_shapes = {}
+            for name, shape in shape_map.items():
+                n_i = slices[i].stop - slices[i].start
+                dev_shapes[name] = (n_i,) + tuple(shape[1:])
+            exec_ = self._symbol.simple_bind(ctx=c, grad_req=self._grad_req,
+                                             **dev_shapes)
+            self._execs.append(exec_)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            arg_params, aux_params = shared_module.get_params()
+            self.set_params(arg_params, aux_params)
+
+    # -- params ---------------------------------------------------------------
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """(reference module.py:init_params)."""
+        from .. import initializer as _init
+
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if initializer is None:
+            initializer = _init.Uniform(0.01)
+
+        self._arg_params = {}
+        self._aux_params = {}
+        ex = self._execs[0]
+        for name in self._param_names:
+            arr = ex.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr[:] = arg_params[name]
+            else:
+                if arg_params is not None and not allow_missing:
+                    raise RuntimeError("%s is not presented" % name)
+                init_arr = np.zeros(arr.shape, dtype=np.float32)
+                initializer(_init.InitDesc(name), init_arr)
+                arr[:] = init_arr
+            self._arg_params[name] = arr.copy()
+        for name in self._aux_names:
+            arr = ex.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr[:] = aux_params[name]
+            else:
+                init_arr = np.zeros(arr.shape, dtype=np.float32)
+                initializer(_init.InitDesc(name), init_arr)
+                arr[:] = init_arr
+            self._aux_params[name] = arr.copy()
+        # replicate to other devices
+        for other in self._execs[1:]:
+            other.copy_params_from({n: ex.arg_dict[n]
+                                    for n in self._param_names},
+                                   {n: ex.aux_dict[n]
+                                    for n in self._aux_names},
+                                   allow_extra_params=True)
+        self.params_initialized = True
+
+    def get_params(self):
+        """(reference module.py:get_params) — gathered to host dicts."""
+        assert self.binded and self.params_initialized
+        ex = self._execs[0]
+        arg_params = {n: ex.arg_dict[n].copy() for n in self._param_names}
+        aux_params = {n: ex.aux_dict[n].copy() for n in self._aux_names}
+        return arg_params, aux_params
+
+    # -- optimizer ------------------------------------------------------------
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """(reference module.py:init_optimizer :474)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params or {})
+            # Normalize gradients by the global batch size (reference
+            # module.py:init_optimizer sets rescale_grad=1/batch_size).
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = 1.0 / self._batch_size
+            optimizer = opt.create(optimizer,
+                                   param_dict=None,
+                                   **optimizer_params)
+            optimizer.idx2name = idx2name
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+        kv, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), None)
+        self._kvstore = kv
+        self._update_on_kvstore = update_on_kvstore and kv is not None
+        if self._kvstore is not None:
+            for i, name in enumerate(self._param_names):
+                self._kvstore.init(i, self._execs[0].arg_dict[name])
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        self.optimizer_initialized = True
+
+    # -- compute --------------------------------------------------------------
+
+    def forward(self, data_batch, is_train=None):
+        """(reference module.py:forward — slices batch across devices,
+        executor_group.py:436)."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        data = data_batch.data
+        label = data_batch.label or []
+        for i, ex in enumerate(self._execs):
+            sl = self._slices[i]
+            feed = {}
+            for name, arr in zip(self._data_names, data):
+                feed[name] = arr[sl.start:sl.stop]
+            for name, arr in zip(self._label_names, label):
+                if name in ex.arg_dict:
+                    feed[name] = arr[sl.start:sl.stop]
+            ex.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        for ex in self._execs:
+            ex.backward(out_grads=out_grads)
+
+    def update(self):
+        """(reference module.py:update :644 →
+        _update_params_on_kvstore)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, name in enumerate(self._param_names):
+                grads = [ex.grad_dict[name] for ex in self._execs]
+                self._kvstore.push(i, grads)
+                weights = [ex.arg_dict[name] for ex in self._execs]
+                self._kvstore.pull(i, out=weights)
+        else:
+            for i, name in enumerate(self._param_names):
+                if name in self._fixed_param_names:
+                    continue
+                grads = [ex.grad_dict[name] for ex in self._execs]
+                grad = grads[0]
+                for g in grads[1:]:
+                    grad = grad + g.as_in_context(grad.context)
+                weight = self._execs[0].arg_dict[name]
+                self._updater(i, grad, weight)
+                for other in self._execs[1:]:
+                    other.arg_dict[name][:] = weight.as_in_context(
+                        other.arg_dict[name].context)
+        # aux states: device 0 is authoritative, replicate
+        for name in self._aux_names:
+            a0 = self._execs[0].aux_dict[name]
+            for other in self._execs[1:]:
+                other.aux_dict[name][:] = a0.as_in_context(
+                    other.aux_dict[name].context)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        n_out = len(self._execs[0].outputs)
+        if len(self._execs) == 1 or not merge_multi_context:
+            if merge_multi_context:
+                return list(self._execs[0].outputs)
+            return [[ex.outputs[i] for ex in self._execs]
+                    for i in range(n_out)]
+        return [nd.concat(*[ex.outputs[i] for ex in self._execs], dim=0)
+                for i in range(n_out)]
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded
+        grads = []
+        for name in self._data_names:
+            idx = self._execs[0].arg_names.index(name)
+            gs = [ex.grad_arrays[idx] for ex in self._execs]
+            grads.append(nd.concat(*gs, dim=0) if len(gs) > 1 else gs[0])
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        for ex in self._execs:
+            mon.install(ex)
+
+    # -- checkpointing --------------------------------------------------------
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """(reference module.py:save_checkpoint)."""
+        from ..model import save_checkpoint as _save
+
+        arg_params, aux_params = self.get_params()
+        _save(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """(reference module.py:load)."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._arg_params = arg_params
+        mod._aux_params = aux_params
+        mod.params_initialized = False
+        mod._preload_params = (arg_params, aux_params)
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def init_params_from_preload(self):
+        if getattr(self, "_preload_params", None):
+            arg, aux = self._preload_params
+            self.init_params(arg_params=arg, aux_params=aux)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=False))
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """(reference module.py:reshape — bucketing support)."""
+        assert self.binded
+        arg_params, aux_params = self.get_params()
+        self.bind(data_shapes, label_shapes, self.for_training,
+                  force_rebind=True)
+        self.set_params(arg_params, aux_params)

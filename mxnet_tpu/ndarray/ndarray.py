@@ -472,6 +472,10 @@ class NDArray:
     def __setitem__(self, key, value):
         key_c = self._convert_index(key)
         if isinstance(value, NDArray):
+            # A write never moves this array: cross-device values are
+            # copied over (device-to-device on TPU, reference CopyFromTo).
+            if value._ctx != self._ctx:
+                value = value.as_in_context(self._ctx)
             value = value._data
         if isinstance(value, (list, tuple, np.ndarray)):
             value = np.asarray(value, dtype=self.dtype)

@@ -352,3 +352,28 @@ def _zeros_like(a):
 @register("ones_like")
 def _ones_like(a):
     return _jnp().ones_like(a)
+
+
+@register("zeros", aliases=("_zeros",), differentiable=False)
+def _zeros(shape=(), dtype="float32"):
+    """Init op (reference: src/operator/tensor/init_op.cc:_zeros)."""
+    return _jnp().zeros(tuple(shape), dtype=dtype)
+
+
+@register("ones", aliases=("_ones",), differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    return _jnp().ones(tuple(shape), dtype=dtype)
+
+
+@register("full", aliases=("_full",), differentiable=False)
+def _full(shape=(), value=0.0, dtype="float32"):
+    return _jnp().full(tuple(shape), value, dtype=dtype)
+
+
+@register("arange", aliases=("_arange",), differentiable=False)
+def _arange_op(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    jnp = _jnp()
+    out = jnp.arange(start, stop, step, dtype=dtype)
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out

@@ -1,0 +1,624 @@
+"""mx.sym — the symbolic graph API.
+
+Reference: python/mxnet/symbol/symbol.py (Symbol class, compose,
+infer_shape, list_arguments/outputs/auxiliary_states, tojson/load,
+simple_bind :1289 / bind :1553 → src/c_api/c_api_executor.cc →
+GraphExecutor) over NNVM's graph IR.
+
+TPU rebuild: the Symbol is a lightweight python DAG over the same op
+registry the imperative API uses. There is no separate NNVM pass
+pipeline — `bind` compiles the whole forward (+backward via jax.vjp)
+graph into single XLA executables (SURVEY.md §7 M3: XLA buffer
+assignment replaces PlanMemory, fusion replaces segment bulking,
+per-shape executable caching replaces bucketed re-binds).
+
+Op composition mirrors the reference exactly: `mx.sym.FullyConnected
+(data=x, num_hidden=10, name='fc1')` auto-creates the missing `weight`/
+`bias` variables named `fc1_weight`/`fc1_bias`; BatchNorm's moving
+stats become auxiliary states.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .base import MXNetError
+from .ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+# Per-op learnable/aux inputs that compose auto-creates when not given
+# (reference: each op's ListArguments/ListAuxiliaryStates). Format:
+# op -> list of (param_name, is_aux, skip_if_attr).
+_OP_PARAM_INPUTS = {
+    "FullyConnected": [("weight", False, None), ("bias", False, "no_bias")],
+    "Convolution": [("weight", False, None), ("bias", False, "no_bias")],
+    "Deconvolution": [("weight", False, None), ("bias", False, "no_bias")],
+    "BatchNorm": [("gamma", False, None), ("beta", False, None),
+                  ("moving_mean", True, None), ("moving_var", True, None)],
+    "LayerNorm": [("gamma", False, None), ("beta", False, None)],
+    "InstanceNorm": [("gamma", False, None), ("beta", False, None)],
+    "Embedding": [("weight", False, None)],
+    "RNN": [("parameters", False, None)],
+    # Loss heads auto-create their label input (reference: SoftmaxOutput's
+    # ListArguments = [data, label], label named <name>_label).
+    "SoftmaxOutput": [("label", False, None)],
+    "LinearRegressionOutput": [("label", False, None)],
+    "LogisticRegressionOutput": [("label", False, None)],
+    "MAERegressionOutput": [("label", False, None)],
+}
+
+# Shape rules for auto-created params given the data shape (reference:
+# each op's InferShape). fn(attrs, dshape) -> {param: shape}.
+
+
+def _fc_shapes(attrs, dshape):
+    num_hidden = int(attrs.get("num_hidden", 0))
+    flatten = attrs.get("flatten", True)
+    in_units = int(np.prod(dshape[1:])) if flatten else dshape[-1]
+    out = {"weight": (num_hidden, in_units)}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (num_hidden,)
+    return out
+
+
+def _conv_shapes(attrs, dshape):
+    kernel = tuple(attrs.get("kernel", ()))
+    num_filter = int(attrs.get("num_filter", 0))
+    num_group = int(attrs.get("num_group", 1))
+    out = {"weight": (num_filter, dshape[1] // num_group) + kernel}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (num_filter,)
+    return out
+
+
+def _deconv_shapes(attrs, dshape):
+    kernel = tuple(attrs.get("kernel", ()))
+    num_filter = int(attrs.get("num_filter", 0))
+    num_group = int(attrs.get("num_group", 1))
+    out = {"weight": (dshape[1], num_filter // num_group) + kernel}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (num_filter,)
+    return out
+
+
+def _bn_shapes(attrs, dshape):
+    axis = int(attrs.get("axis", 1))
+    c = dshape[axis]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,),
+            "moving_var": (c,)}
+
+
+def _ln_shapes(attrs, dshape):
+    axis = int(attrs.get("axis", -1))
+    c = dshape[axis]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _in_shapes(attrs, dshape):
+    return {"gamma": (dshape[1],), "beta": (dshape[1],)}
+
+
+def _embedding_shapes(attrs, dshape):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+def _softmax_out_shapes(attrs, dshape):
+    if attrs.get("multi_output", False):
+        return {"label": (dshape[0],) + tuple(dshape[2:])}
+    return {"label": (dshape[0],)}
+
+
+def _regression_shapes(attrs, dshape):
+    return {"label": tuple(dshape)}
+
+
+def _rnn_shapes(attrs, dshape):
+    # dshape (T, N, input); total fused param size per rnn op spec.
+    from .ops.rnn_ops import rnn_param_size
+
+    return {"parameters": (rnn_param_size(
+        int(attrs["num_layers"]), int(attrs["state_size"]), dshape[2],
+        attrs.get("mode", "lstm"), bool(attrs.get("bidirectional", False))),)}
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_shapes,
+    "Convolution": _conv_shapes,
+    "Deconvolution": _deconv_shapes,
+    "BatchNorm": _bn_shapes,
+    "LayerNorm": _ln_shapes,
+    "InstanceNorm": _in_shapes,
+    "Embedding": _embedding_shapes,
+    "RNN": _rnn_shapes,
+    "SoftmaxOutput": _softmax_out_shapes,
+    "LinearRegressionOutput": _regression_shapes,
+    "LogisticRegressionOutput": _regression_shapes,
+    "MAERegressionOutput": _regression_shapes,
+}
+
+_UNNAMED_COUNT = {}
+
+
+def _auto_name(hint):
+    cnt = _UNNAMED_COUNT.get(hint, 0)
+    _UNNAMED_COUNT[hint] = cnt + 1
+    return "%s%d" % (hint, cnt)
+
+
+class Symbol:
+    """A node in the symbolic graph (reference symbol.py:Symbol)."""
+
+    def __init__(self, op, attrs=None, inputs=None, name=None, is_aux=False,
+                 out_index=None, num_outputs=1):
+        self._op = op  # None => variable; "_group" => output group
+        self._attrs = dict(attrs or {})
+        self._inputs = list(inputs or [])
+        self._name = name
+        self._is_aux = is_aux
+        self._out_index = out_index
+        self._num_outputs = num_outputs
+
+    # -- identity -------------------------------------------------------------
+
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attrs.get("__%s__" % key)
+
+    def _set_attr(self, **kwargs):
+        for k, v in kwargs.items():
+            self._attrs["__%s__" % k] = v
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            d = {k[2:-2]: v for k, v in node._attrs.items()
+                 if k.startswith("__") and k.endswith("__")}
+            if d and node._name:
+                out[node._name] = d
+        return out
+
+    def __repr__(self):
+        if self._op is None:
+            return "<Symbol variable %s>" % self._name
+        return "<Symbol %s>" % (self._name or self._op)
+
+    # -- graph traversal ------------------------------------------------------
+
+    def _topo(self):
+        seen = set()
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for i in node._inputs:
+                visit(i)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    def list_arguments(self):
+        """Topo-ordered input variable names (reference
+        symbol.py:list_arguments)."""
+        return [n._name for n in self._topo()
+                if n._op is None and not n._is_aux]
+
+    def list_auxiliary_states(self):
+        return [n._name for n in self._topo() if n._op is None and n._is_aux]
+
+    def list_outputs(self):
+        if self._op == "_group":
+            out = []
+            for s in self._inputs:
+                out.extend(s.list_outputs())
+            return out
+        base = self._name or self._op
+        if self._num_outputs == 1 or self._out_index is not None:
+            return ["%s_output" % base]
+        return ["%s_output%d" % (base, i) for i in range(self._num_outputs)]
+
+    def get_internals(self):
+        """All nodes as a group (reference symbol.py:get_internals)."""
+        return Group([n for n in self._topo() if n._op != "_group"])
+
+    def __getitem__(self, index):
+        if self._op == "_group":
+            if isinstance(index, str):
+                for s in self._inputs:
+                    outs = s.list_outputs()
+                    if index in outs or s._name == index:
+                        return s
+                raise ValueError("Cannot find output %r" % index)
+            return self._inputs[index]
+        if isinstance(index, int):
+            if self._num_outputs == 1:
+                if index != 0:
+                    raise IndexError(index)
+                return self
+            return Symbol(self._op, self._attrs, self._inputs, self._name,
+                          out_index=index, num_outputs=self._num_outputs)
+        raise TypeError(index)
+
+    @property
+    def outputs(self):
+        if self._op == "_group":
+            return list(self._inputs)
+        return [self]
+
+    # -- composition: operators -----------------------------------------------
+
+    def __add__(self, other):
+        return _invoke_sym("_plus", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _invoke_sym("_minus", self, other)
+
+    def __rsub__(self, other):
+        return _invoke_sym("_rminus", self, other)
+
+    def __mul__(self, other):
+        return _invoke_sym("_mul", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _invoke_sym("_div", self, other)
+
+    def __rtruediv__(self, other):
+        return _invoke_sym("_rdiv", self, other)
+
+    def __pow__(self, other):
+        return _invoke_sym("_power", self, other)
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    # -- shape/type inference -------------------------------------------------
+
+    def infer_shape(self, *args, **kwargs):
+        """Infer (arg_shapes, out_shapes, aux_shapes) given some input
+        shapes (reference symbol.py:infer_shape). Returns lists ordered
+        like list_arguments()/list_outputs()/list_auxiliary_states()."""
+        known = dict(kwargs)
+        if args:
+            arg_names = self.list_arguments()
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = shape
+        shapes = self._infer_all_shapes(known)
+        if shapes is None:
+            return None, None, None
+        arg_shapes = [shapes.get(n) for n in self.list_arguments()]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [shapes[("out", id(s), s._out_index or 0)]
+                      for s in self.outputs]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self.infer_shape(*args, **kwargs)
+        except MXNetError:
+            return None, None, None
+
+    def _infer_all_shapes(self, known):
+        """Forward shape propagation: auto-param shapes from table rules,
+        everything else via jax.eval_shape on the op's FCompute."""
+        import jax
+
+        shapes = dict(known)
+
+        for node in self._topo():
+            if node._op is None or node._op == "_group":
+                continue
+            op_name = node._attrs.get("_op_name", node._op)
+            # fill auto-created param inputs via the rule table
+            rule = _PARAM_SHAPE_RULES.get(op_name)
+            if rule is not None and node._inputs:
+                data = node._inputs[0]
+                dname = data._name if data._op is None else None
+                dshape = shapes.get(dname) if dname else \
+                    shapes.get(("out", id(data), data._out_index or 0))
+                if dshape is not None:
+                    param_shapes = rule(node._clean_attrs(), tuple(dshape))
+                    for inp in node._inputs[1:]:
+                        if inp._op is None and inp._name:
+                            for pname, pshape in param_shapes.items():
+                                if inp._name.endswith("_" + pname) or \
+                                        inp._name == pname:
+                                    shapes.setdefault(inp._name, pshape)
+            # now eval_shape the node if all inputs known
+            in_shapes = []
+            ok = True
+            for inp in node._inputs:
+                s = shapes.get(inp._name) if inp._op is None else \
+                    shapes.get(("out", id(inp), inp._out_index or 0))
+                if s is None:
+                    ok = False
+                    break
+                in_shapes.append(tuple(s))
+            if not ok:
+                raise MXNetError(
+                    "infer_shape: missing input shapes for node %s (%s)"
+                    % (node._name or op_name, op_name))
+            op = _registry.get(op_name)
+            structs = [jax.ShapeDtypeStruct(s, np.float32) for s in in_shapes]
+            fn = op.bound_fn(node._clean_attrs())
+            args = structs
+            if op.needs_rng:
+                key_struct = jax.ShapeDtypeStruct((2,), np.uint32)
+                args = [key_struct] + args
+            try:
+                out = jax.eval_shape(fn, *args)
+            except Exception as e:
+                raise MXNetError("infer_shape failed at %s: %s"
+                                 % (node._name or op_name, e)) from None
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, o in enumerate(outs):
+                shapes[("out", id(node), i)] = tuple(o.shape)
+            shapes[("out", id(node), None)] = tuple(outs[0].shape)
+        return shapes
+
+    def infer_type(self, **kwargs):
+        """All-float32 default (reference infer_type; dtype plumbing is
+        per-executor here)."""
+        arg_types = [np.float32 for _ in self.list_arguments()]
+        out_types = [np.float32 for _ in self.outputs]
+        aux_types = [np.float32 for _ in self.list_auxiliary_states()]
+        return arg_types, out_types, aux_types
+
+    def _clean_attrs(self):
+        return {k: v for k, v in self._attrs.items()
+                if not (k.startswith("__") and k.endswith("__"))
+                and k != "_op_name"}
+
+    # -- serialization --------------------------------------------------------
+
+    def tojson(self):
+        """JSON graph (reference symbol.py:tojson; format is own but
+        stable — nodes with op/name/attrs/input indices)."""
+        order = [n for n in self._topo() if n._op != "_group"]
+        index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            nodes.append({
+                "op": n._op or "null",
+                "name": n._name,
+                "attrs": _jsonify_attrs(n._attrs),
+                "inputs": [[index[id(i)], i._out_index or 0] for i in n._inputs],
+                "is_aux": n._is_aux,
+                "out_index": n._out_index,
+                "num_outputs": n._num_outputs,
+            })
+        heads = [[index[id(s)], s._out_index or 0] for s in self.outputs]
+        return json.dumps({"nodes": nodes, "heads": heads,
+                           "mxnet_tpu_version": 1}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- execution ------------------------------------------------------------
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        """Allocate arrays from inferred shapes and bind (reference
+        symbol.py:simple_bind :1289)."""
+        from . import ndarray as nd
+        from .executor import Executor
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            raise MXNetError("simple_bind: could not infer all shapes "
+                             "from %s" % kwargs)
+        args = [nd.zeros(s, ctx=ctx) for s in arg_shapes]
+        grad_arrays = None
+        if grad_req != "null":
+            grad_arrays = [nd.zeros(s, ctx=ctx) for s in arg_shapes]
+        aux = [nd.zeros(s, ctx=ctx) for s in (aux_shapes or [])]
+        return Executor(self, ctx, args, grad_arrays, grad_req, aux,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        """One-shot forward with kwargs as arg arrays (reference
+        symbol.py:eval)."""
+        ex = self.bind(ctx, args=kwargs, grad_req="null")
+        return ex.forward(is_train=False)
+
+    # numpy-style conveniences used by module code
+    def __iter__(self):
+        return iter(self.outputs)
+
+    def __len__(self):
+        return len(self.outputs)
+
+
+def _jsonify_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (np.ndarray, np.generic)):
+            v = v.tolist()
+        elif isinstance(v, tuple):
+            v = list(v)
+        out[k] = v
+    return out
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable (reference symbol.py:var)."""
+    s = Symbol(None, name=name)
+    if attr:
+        s._attrs.update({"__%s__" % k: v for k, v in attr.items()})
+    if shape is not None:
+        s._attrs["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        s._attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        s._attrs["__wd_mult__"] = wd_mult
+    return s
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group outputs (reference symbol.py:Group)."""
+    flat = []
+    for s in symbols:
+        flat.extend(s.outputs)
+    return Symbol("_group", inputs=flat)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for nd_ in data["nodes"]:
+        op = None if nd_["op"] == "null" else nd_["op"]
+        inputs = [nodes[i][oi] if nodes[i]._num_outputs > 1 and oi
+                  else nodes[i] for i, oi in nd_["inputs"]]
+        attrs = {k: (tuple(v) if isinstance(v, list) else v)
+                 for k, v in nd_.get("attrs", {}).items()}
+        s = Symbol(op if op != "_group" else "_group", attrs=attrs,
+                   inputs=inputs, name=nd_.get("name"),
+                   is_aux=nd_.get("is_aux", False),
+                   out_index=nd_.get("out_index"),
+                   num_outputs=nd_.get("num_outputs", 1))
+        nodes.append(s)
+    heads = [nodes[i] if nodes[i]._num_outputs == 1 else nodes[i][oi]
+             for i, oi in data["heads"]]
+    if len(heads) == 1:
+        return heads[0]
+    return Group(heads)
+
+
+# -- op composition ----------------------------------------------------------
+
+def _as_symbol(x, ref_name="scalar"):
+    if isinstance(x, Symbol):
+        return x
+    raise TypeError("expected Symbol, got %r" % (x,))
+
+
+def _invoke_sym(op_name, lhs, rhs):
+    """Binary operator composition, scalar-aware (reference: the
+    _internal _plus/_plus_scalar split)."""
+    if isinstance(rhs, Symbol):
+        return _make_symbol_op(op_name)(lhs, rhs)
+    scalar_map = {"_plus": "_plus_scalar", "_minus": "_minus_scalar",
+                  "_rminus": "_rminus_scalar", "_mul": "_mul_scalar",
+                  "_div": "_div_scalar", "_rdiv": "_rdiv_scalar",
+                  "_power": "_power_scalar"}
+    return _make_symbol_op(scalar_map[op_name])(lhs, scalar=float(rhs))
+
+
+_SYM_FUNC_CACHE = {}
+
+
+def _make_symbol_op(op_name):
+    """Build the symbolic composer for a registered op: Symbols in
+    args/kwargs become node inputs; scalars become attrs; missing
+    learnable inputs are auto-created variables."""
+    import inspect
+
+    fn = _SYM_FUNC_CACHE.get(op_name)
+    if fn is not None:
+        return fn
+    op = _registry.get(op_name)
+    try:
+        sig_params = [p for p in inspect.signature(op.fn).parameters
+                      if p != "rng_key"]
+    except (TypeError, ValueError):
+        sig_params = []
+    param_inputs = _OP_PARAM_INPUTS.get(op_name, [])
+    param_names = {p[0] for p in param_inputs}
+
+    def sym_op(*args, name=None, attr=None, **kwargs):
+        inputs = {}
+        attrs = {}
+        pos = 0
+        for a in args:
+            if isinstance(a, Symbol):
+                # assign to next unfilled signature slot
+                while pos < len(sig_params) and sig_params[pos] in inputs:
+                    pos += 1
+                pname = sig_params[pos] if pos < len(sig_params) \
+                    else "arg%d" % pos
+                inputs[pname] = a
+                pos += 1
+            else:
+                pname = sig_params[pos] if pos < len(sig_params) \
+                    else "arg%d" % pos
+                attrs[pname] = a
+                pos += 1
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                inputs[k] = v
+            elif v is not None:
+                attrs[k] = v
+        name_ = name or _auto_name(op_name.lower().lstrip("_"))
+        # auto-create missing learnable/aux inputs
+        for pname, is_aux, skip_attr in param_inputs:
+            if pname in inputs:
+                continue
+            if skip_attr and attrs.get(skip_attr):
+                continue
+            inputs[pname] = Symbol(None, name="%s_%s" % (name_, pname),
+                                   is_aux=is_aux)
+        # order inputs per signature
+        ordered = [inputs[p] for p in sig_params if p in inputs]
+        extra = [v for k, v in inputs.items() if k not in sig_params]
+        node_attrs = dict(attrs)
+        node_attrs["_op_name"] = op_name
+        if attr:
+            node_attrs.update({"__%s__" % k: v for k, v in attr.items()})
+        n_out = 2 if op_name in ("RNN",) else 1
+        return Symbol(op_name, attrs=node_attrs, inputs=ordered + extra,
+                      name=name_, num_outputs=n_out)
+
+    sym_op.__name__ = op_name
+    _SYM_FUNC_CACHE[op_name] = sym_op
+    return sym_op
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _make_symbol_op("zeros")(shape=shape, dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _make_symbol_op("ones")(shape=shape, dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, **kwargs):
+    return _make_symbol_op("arange")(start=start, stop=stop, step=step,
+                                     **kwargs)
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    _registry.get(name)  # raises AttributeError if unknown
+    return _make_symbol_op(name)

@@ -1,0 +1,175 @@
+"""Symbol/Executor/Module tests (reference:
+tests/python/unittest/test_module.py, test_executor.py,
+tests/python/train/test_mlp.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.module import Module, BucketingModule
+
+
+def _mlp_symbol(hidden=32, classes=2):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_data(n=200, d=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    Y = (X @ w > 0).astype(np.float32)
+    return X, Y
+
+
+def test_symbol_compose_infer():
+    out = _mlp_symbol()
+    assert out.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias",
+                                    "softmax_label"]
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(4, 10))
+    assert arg_shapes[1] == (32, 10)
+    assert out_shapes == [(4, 2)]
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    out = _mlp_symbol()
+    path = str(tmp_path / "sym.json")
+    out.save(path)
+    loaded = mx.sym.load(path)
+    assert loaded.list_arguments() == out.list_arguments()
+    a1, o1, _ = loaded.infer_shape(data=(2, 10))
+    a2, o2, _ = out.infer_shape(data=(2, 10))
+    assert a1 == a2 and o1 == o2
+
+
+def test_symbol_arith_operators():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = (a + b) * 2.0 - a / b
+    ex = c.bind(ctx=mx.cpu(), args={"a": mx.nd.array([4.0]),
+                                    "b": mx.nd.array([2.0])})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [(4 + 2) * 2 - 4 / 2])
+
+
+def test_symbol_group_internals():
+    out = _mlp_symbol()
+    internals = out.get_internals()
+    names = [s.name for s in internals.outputs]
+    assert "fc1" in names
+
+
+def test_executor_grad():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    loss = mx.sym.LinearRegressionOutput(data * w, name="lro")
+    ex = loss.bind(ctx=mx.cpu(),
+                   args={"data": mx.nd.array([2.0]), "w": mx.nd.array([3.0]),
+                         "lro_label": mx.nd.array([10.0])},
+                   grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward()
+    # d/dw of 0.5*(w*d - y)^2-ish: reference grad = (out - label) * d
+    g = ex.grad_dict["w"].asnumpy()
+    np.testing.assert_allclose(g, [(6.0 - 10.0) * 2.0], rtol=1e-5)
+
+
+def test_executor_reshape():
+    out = _mlp_symbol()
+    ex = out.simple_bind(ctx=mx.cpu(), data=(8, 10))
+    ex2 = ex.reshape(data=(4, 10))
+    assert ex2.arg_dict["data"].shape == (4, 10)
+    # weights shared by reference
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+
+
+def test_module_fit():
+    X, Y = _toy_data()
+    it = mx.io.NDArrayIter(X, Y, batch_size=20, shuffle=True,
+                           last_batch_handle="discard")
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    X, Y = _toy_data(50)
+    it = mx.io.NDArrayIter(X, Y, batch_size=10)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (50, 2)
+
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    assert sym2.list_arguments() == mod.symbol.list_arguments()
+
+    mod2 = Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params_from_preload()
+    it.reset()
+    preds2 = mod2.predict(it)
+    np.testing.assert_allclose(preds.asnumpy(), preds2.asnumpy(), rtol=1e-5)
+
+
+def test_module_multi_device():
+    X, Y = _toy_data()
+    it = mx.io.NDArrayIter(X, Y, batch_size=40, last_batch_handle="discard")
+    mod = Module(_mlp_symbol(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(it, num_epoch=8, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_batchnorm_aux():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", fix_gamma=False)
+    fc = mx.sym.FullyConnected(bn, num_hidden=2, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    assert out.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    ex = out.simple_bind(ctx=mx.cpu(), data=(4, 3))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.arg_dict["fc_weight"][:] = 0.1
+    ex.arg_dict["data"][:] = np.random.rand(4, 3).astype(np.float32) * 5
+    ex.forward(is_train=True)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert np.abs(mm).sum() > 0  # moving stats updated in train mode
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        # Param shapes must be bucket-invariant (reference bucketing
+        # contract): reduce over the variable axis before the FC.
+        data = mx.sym.Variable("data")
+        pooled = mx.sym.mean(data, axis=1, keepdims=True)
+        fc = mx.sym.FullyConnected(pooled, num_hidden=2, name="fc")
+        out = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    from mxnet_tpu.io import DataDesc, DataBatch
+
+    mod.bind(data_shapes=[DataDesc("data", (4, 10))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    for key in (10, 5, 10):
+        batch = DataBatch(
+            data=[mx.nd.ones((4, key))], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[DataDesc("data", (4, key))],
+            provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets) == {10, 5}
