@@ -265,7 +265,12 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         if self._kvstore is not None and self._update_on_kvstore:
+            # Fixed params bind with grad_req null in the reference executor
+            # group; here they still allocate grads, so skip them explicitly
+            # to avoid silently updating frozen parameters.
             for i, name in enumerate(self._param_names):
+                if name in self._fixed_param_names:
+                    continue
                 grads = [ex.grad_dict[name] for ex in self._execs]
                 self._kvstore.push(i, grads)
                 weights = [ex.arg_dict[name] for ex in self._execs]
@@ -298,7 +303,13 @@ class Module(BaseModule):
                 return list(self._execs[0].outputs)
             return [[ex.outputs[i] for ex in self._execs]
                     for i in range(n_out)]
-        return [nd.concat(*[ex.outputs[i] for ex in self._execs], dim=0)
+        # Per-device outputs live on different devices; concat is a jitted
+        # computation and requires co-located inputs, so gather to ctx[0]
+        # first (reference executor_group.py:_merge_multi_context copies to
+        # a single ctx the same way).
+        ctx0 = self._context[0]
+        return [nd.concat(*[ex.outputs[i].as_in_context(ctx0)
+                            for ex in self._execs], dim=0)
                 for i in range(n_out)]
 
     def get_input_grads(self, merge_multi_context=True):
@@ -307,7 +318,12 @@ class Module(BaseModule):
         for name in self._data_names:
             idx = self._execs[0].arg_names.index(name)
             gs = [ex.grad_arrays[idx] for ex in self._execs]
-            grads.append(nd.concat(*gs, dim=0) if len(gs) > 1 else gs[0])
+            if len(gs) > 1:
+                ctx0 = self._context[0]
+                grads.append(nd.concat(*[g.as_in_context(ctx0) for g in gs],
+                                       dim=0))
+            else:
+                grads.append(gs[0])
         return grads
 
     def update_metric(self, eval_metric, labels):

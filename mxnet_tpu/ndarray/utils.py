@@ -1,18 +1,21 @@
-"""NDArray save/load.
+"""NDArray save/load — wire-compatible with the reference binary format.
 
-Reference: src/ndarray/ndarray.cc:1537-1745 (binary format with magic +
-names) and python/mxnet/ndarray/utils.py:149-222 (mx.nd.save/load).
+Reference: src/ndarray/ndarray.cc:1537-1745 (NDArray::Save/Load with
+NDARRAY_V2_MAGIC + kMXAPINDArrayListMagic list container) and
+python/mxnet/ndarray/utils.py:149-222 (mx.nd.save/load).
 
-TPU rebuild: same user contract (list or dict of arrays round-trips,
-`.params` files interoperate across our Gluon/Module checkpoints). The
-container is .npz-based rather than the reference's private binary
-layout; arrays are gathered from device before write (SURVEY.md §5.4).
+TPU rebuild: `.params` files produced here are byte-identical in layout
+to the reference's (list magic 0x112, per-array V2 magic 0xF993fac9,
+dmlc-serialized names), so checkpoints interoperate with reference
+tooling in both directions. Dense, row_sparse and csr arrays serialize
+natively; arrays are gathered from device to host before write
+(SURVEY.md §5.4). Files written by round-1 builds (.npz container) are
+still loadable.
 """
 from __future__ import annotations
 
-import io as _io
 import os
-import zipfile
+import struct
 
 import numpy as np
 
@@ -22,34 +25,217 @@ __all__ = ["save", "load", "save_dict", "load_dict"]
 
 _LIST_PREFIX = "__mxtpu_list__:"
 
+# src/ndarray/ndarray.cc:1532-1535
+_NDARRAY_V1_MAGIC = 0xF993FAC8
+_NDARRAY_V2_MAGIC = 0xF993FAC9
+# src/ndarray/ndarray.cc:1735
+_LIST_MAGIC = 0x112
+
+# mshadow type flags (mshadow/base.h kFloat32..kInt64)
+_TYPE_FLAG_TO_DTYPE = {
+    0: np.float32, 1: np.float64, 2: np.float16,
+    3: np.uint8, 4: np.int32, 5: np.int8, 6: np.int64,
+}
+_DTYPE_TO_TYPE_FLAG = {np.dtype(v): k for k, v in _TYPE_FLAG_TO_DTYPE.items()}
+# bfloat16 has no reference type flag; promote to float32 on save.
+
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+
+
+def _write_shape(f, shape):
+    """nnvm::TShape::Save — uint32 ndim + int64 dims (tuple.h)."""
+    f.write(struct.pack("<I", len(shape)))
+    if shape:
+        f.write(struct.pack("<%dq" % len(shape), *shape))
+
+
+def _read_shape(f, int64=True):
+    (ndim,) = struct.unpack("<I", f.read(4))
+    if ndim == 0:
+        return ()
+    fmt = "<%dq" % ndim if int64 else "<%dI" % ndim
+    return struct.unpack(fmt, f.read((8 if int64 else 4) * ndim))
+
+
+def _np_of(arr):
+    if isinstance(arr, NDArray):
+        return arr.asnumpy()
+    return np.asarray(arr)
+
+
+def _type_flag(a):
+    dt = np.dtype(a.dtype)
+    if dt not in _DTYPE_TO_TYPE_FLAG:
+        # bfloat16 / unsupported: promote to float32 for interop
+        return 0, a.astype(np.float32)
+    return _DTYPE_TO_TYPE_FLAG[dt], a
+
+
+def _save_ndarray(f, arr):
+    """NDArray::Save (ndarray.cc:1538-1602) — V2 layout."""
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    f.write(struct.pack("<I", _NDARRAY_V2_MAGIC))
+    if isinstance(arr, RowSparseNDArray):
+        data = _np_of(arr.data)
+        idx = _np_of(arr.indices).astype(np.int64)
+        tf, data = _type_flag(data)
+        f.write(struct.pack("<i", _STYPE_ROW_SPARSE))
+        _write_shape(f, data.shape)           # storage_shape
+        _write_shape(f, arr.shape)
+        f.write(struct.pack("<ii", 1, 0))     # Context{cpu, 0}
+        f.write(struct.pack("<i", tf))
+        f.write(struct.pack("<i", 6))         # aux idx type int64
+        _write_shape(f, idx.shape)
+        f.write(np.ascontiguousarray(data).tobytes())
+        f.write(np.ascontiguousarray(idx).tobytes())
+    elif isinstance(arr, CSRNDArray):
+        data = _np_of(arr.data)
+        indptr = _np_of(arr.indptr).astype(np.int64)
+        idx = _np_of(arr.indices).astype(np.int64)
+        tf, data = _type_flag(data)
+        f.write(struct.pack("<i", _STYPE_CSR))
+        _write_shape(f, data.shape)           # storage_shape = (nnz,)
+        _write_shape(f, arr.shape)
+        f.write(struct.pack("<ii", 1, 0))
+        f.write(struct.pack("<i", tf))
+        f.write(struct.pack("<i", 6))         # kIndPtr type
+        _write_shape(f, indptr.shape)
+        f.write(struct.pack("<i", 6))         # kIdx type
+        _write_shape(f, idx.shape)
+        f.write(np.ascontiguousarray(data).tobytes())
+        f.write(np.ascontiguousarray(indptr).tobytes())
+        f.write(np.ascontiguousarray(idx).tobytes())
+    else:
+        data = _np_of(arr)
+        tf, data = _type_flag(data)
+        # The reference cannot represent 0-d arrays (TShape ndim==0 means
+        # "none" and Save early-returns right after the shape,
+        # ndarray.cc:1556); promote scalars to shape (1,) so the value
+        # survives and the stream stays parseable.
+        if data.ndim == 0:
+            data = data.reshape(1)
+        f.write(struct.pack("<i", _STYPE_DEFAULT))
+        _write_shape(f, data.shape)
+        f.write(struct.pack("<ii", 1, 0))
+        f.write(struct.pack("<i", tf))
+        f.write(np.ascontiguousarray(data).tobytes())
+
+
+def _read_raw(f, shape, type_flag):
+    dt = np.dtype(_TYPE_FLAG_TO_DTYPE[type_flag])
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    buf = f.read(dt.itemsize * n)
+    return np.frombuffer(buf, dtype=dt).reshape(shape).copy()
+
+
+def _load_ndarray(f):
+    """NDArray::Load incl. legacy V1 / raw-ndim paths (ndarray.cc:1604-1733)."""
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    (magic,) = struct.unpack("<I", f.read(4))
+    if magic != _NDARRAY_V2_MAGIC:
+        # LegacyLoad: V1 uses int64 TShape; anything else means `magic`
+        # itself was the ndim of a uint32 legacy shape.
+        if magic == _NDARRAY_V1_MAGIC:
+            shape = _read_shape(f, int64=True)
+        else:
+            ndim = magic
+            shape = struct.unpack("<%dI" % ndim, f.read(4 * ndim)) \
+                if ndim else ()
+        if not shape:
+            return array(np.zeros((), np.float32))
+        f.read(8)  # Context
+        (tf,) = struct.unpack("<i", f.read(4))
+        return array(_read_raw(f, shape, tf))
+
+    (stype,) = struct.unpack("<i", f.read(4))
+    nad = {_STYPE_DEFAULT: 0, _STYPE_ROW_SPARSE: 1, _STYPE_CSR: 2}[stype]
+    sshape = _read_shape(f) if nad > 0 else None
+    shape = _read_shape(f)
+    if not shape:
+        return array(np.zeros((), np.float32))
+    f.read(8)  # Context (always loaded to host here)
+    (tf,) = struct.unpack("<i", f.read(4))
+    aux = []
+    for _ in range(nad):
+        (atf,) = struct.unpack("<i", f.read(4))
+        ashape = _read_shape(f)
+        aux.append((atf, ashape))
+    data = _read_raw(f, sshape if nad > 0 else shape, tf)
+    aux_data = [_read_raw(f, s, t) for t, s in aux]
+    if stype == _STYPE_DEFAULT:
+        return array(data)
+    if stype == _STYPE_ROW_SPARSE:
+        return RowSparseNDArray(array(data), array(aux_data[0], dtype="int64"),
+                                shape)
+    return CSRNDArray(array(data), array(aux_data[0], dtype="int64"),
+                      array(aux_data[1], dtype="int64"), shape)
+
 
 def save(fname, data):
-    """Save a list or dict of NDArrays (reference: mx.nd.save)."""
-    arrays = {}
+    """Save a list or dict of NDArrays (reference: mx.nd.save;
+    MXNDArraySave → NDArray::Save list format, ndarray.cc:1735-1745)."""
     if isinstance(data, NDArray):
         data = [data]
-    if isinstance(data, (list, tuple)):
-        for i, v in enumerate(data):
-            arrays["%s%d" % (_LIST_PREFIX, i)] = v.asnumpy()
-    elif isinstance(data, dict):
-        for k, v in data.items():
-            arrays[k] = v.asnumpy()
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        names = []
+        arrays = list(data)
     else:
         raise TypeError("save expects NDArray, list or dict")
     tmp = fname + ".tmp%d" % os.getpid()
     with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _save_ndarray(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
     os.replace(tmp, fname)
 
 
-def load(fname):
-    """Load NDArrays saved by :func:`save` (reference: mx.nd.load)."""
+def _load_npz(fname):
+    """Round-1 .npz container fallback."""
     with np.load(fname, allow_pickle=False) as z:
         keys = list(z.keys())
         if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
             keys.sort(key=lambda k: int(k[len(_LIST_PREFIX):]))
             return [array(z[k]) for k in keys]
         return {k: array(z[k]) for k in keys}
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save` or by reference mx.nd.save
+    (reference: mx.nd.load; NDArray::Load ndarray.cc:1747-1762)."""
+    try:
+        with open(fname, "rb") as f:
+            head = f.read(8)
+            if head[:2] == b"PK":
+                return _load_npz(fname)
+            (header,) = struct.unpack("<Q", head)
+            if header != _LIST_MAGIC:
+                raise ValueError("%s: invalid NDArray file format" % fname)
+            f.read(8)  # reserved
+            (n,) = struct.unpack("<Q", f.read(8))
+            arrays = [_load_ndarray(f) for _ in range(n)]
+            (nk,) = struct.unpack("<Q", f.read(8))
+            names = []
+            for _ in range(nk):
+                (ln,) = struct.unpack("<Q", f.read(8))
+                names.append(f.read(ln).decode("utf-8"))
+    except (struct.error, KeyError, IndexError) as e:
+        raise ValueError("%s: invalid NDArray file format (%s)" % (fname, e))
+    if not names:
+        return arrays
+    if len(names) != len(arrays):
+        raise ValueError("%s: invalid NDArray file format" % fname)
+    return dict(zip(names, arrays))
 
 
 def save_dict(fname, data):

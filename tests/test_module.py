@@ -173,3 +173,57 @@ def test_bucketing_module():
         mod.backward()
         mod.update()
     assert set(mod._buckets) == {10, 5}
+
+
+def test_module_multi_device_convergence():
+    """2-device DP training converges on a separable toy problem
+    (reference: tests/nightly/multi_lenet.py's multi-GPU DP check)."""
+    X, Y = _toy_data(n=256, d=10, seed=3)
+    mod = Module(_mlp_symbol(), context=[mx.cpu(0), mx.cpu(1)])
+    from mxnet_tpu.io import DataBatch
+
+    mod.bind(data_shapes=[("data", (64, 10))],
+             label_shapes=[("softmax_label", (64,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for epoch in range(30):
+        for i in range(0, 256, 64):
+            batch = DataBatch(data=[mx.nd.array(X[i:i + 64])],
+                              label=[mx.nd.array(Y[i:i + 64])])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    out = []
+    for i in range(0, 256, 64):
+        batch = DataBatch(data=[mx.nd.array(X[i:i + 64])],
+                          label=[mx.nd.array(Y[i:i + 64])])
+        mod.forward(batch, is_train=False)
+        out.append(mod.get_outputs()[0].asnumpy())
+    pred = np.concatenate(out).argmax(axis=1)
+    acc = (pred == Y).mean()
+    assert acc > 0.9, "2-device DP failed to converge: acc=%.3f" % acc
+
+
+def test_module_fixed_params_kvstore():
+    """Frozen params must not move under the kvstore update path
+    (ADVICE r1: fixed_param_names ignored in kvstore branch)."""
+    from mxnet_tpu.io import DataBatch
+
+    X, Y = _toy_data(n=64, d=10, seed=5)
+    mod = Module(_mlp_symbol(), context=[mx.cpu(0), mx.cpu(1)],
+                 fixed_param_names=["fc1_weight", "fc1_bias"])
+    mod.bind(data_shapes=[("data", (64, 10))],
+             label_shapes=[("softmax_label", (64,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    before = mod._execs[0].arg_dict["fc1_weight"].asnumpy().copy()
+    moved = mod._execs[0].arg_dict["fc2_weight"].asnumpy().copy()
+    batch = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    after = mod._execs[0].arg_dict["fc1_weight"].asnumpy()
+    assert np.allclose(before, after), "fixed param was updated"
+    assert not np.allclose(moved, mod._execs[0].arg_dict["fc2_weight"].asnumpy())

@@ -205,3 +205,46 @@ def test_where_clip():
     assert np.allclose(c.asnumpy(), [-1, -1, 0, 1, 1])
     w = nd.where(x > 0, x, -x)
     assert np.allclose(w.asnumpy(), [2, 1, 0, 1, 2])
+
+
+def test_save_load_reference_wire_format(tmp_path):
+    """.params files use the reference's binary layout: list magic 0x112,
+    per-array V2 magic 0xF993fac9 (src/ndarray/ndarray.cc:1537-1745)."""
+    import struct
+
+    path = str(tmp_path / "x.params")
+    d = {"arg:w": nd.array([[1.0, 2.0], [3.0, 4.0]]),
+         "aux:m": nd.array([5, 6], dtype="int64")}
+    nd.save(path, d)
+    raw = open(path, "rb").read()
+    assert struct.unpack("<Q", raw[:8])[0] == 0x112
+    assert struct.unpack("<I", raw[24:28])[0] == 0xF993FAC9
+    out = nd.load(path)
+    assert set(out) == {"arg:w", "aux:m"}
+    assert np.allclose(out["arg:w"].asnumpy(), [[1, 2], [3, 4]])
+    # jax runs with x64 disabled, so int64 payloads surface as int32
+    assert np.issubdtype(out["aux:m"].dtype, np.integer)
+
+    # list round-trip
+    lst_path = str(tmp_path / "l.params")
+    nd.save(lst_path, [nd.ones((3,)), nd.zeros((2, 2))])
+    lst = nd.load(lst_path)
+    assert isinstance(lst, list) and len(lst) == 2
+    assert np.allclose(lst[0].asnumpy(), 1)
+
+
+def test_save_load_sparse_wire_format(tmp_path):
+    from mxnet_tpu.ndarray import sparse
+
+    path = str(tmp_path / "sp.params")
+    rsp = sparse.row_sparse_array(
+        np.array([[0, 0], [1, 2], [0, 0], [3, 4]], np.float32))
+    csr = sparse.csr_matrix(
+        np.array([[1, 0, 2], [0, 0, 3]], np.float32))
+    nd.save(path, {"rsp": rsp, "csr": csr})
+    out = nd.load(path)
+    assert out["rsp"].stype == "row_sparse"
+    assert np.allclose(out["rsp"].asnumpy(),
+                       [[0, 0], [1, 2], [0, 0], [3, 4]])
+    assert out["csr"].stype == "csr"
+    assert np.allclose(out["csr"].asnumpy(), [[1, 0, 2], [0, 0, 3]])
