@@ -56,7 +56,7 @@ _LAZY = {
     "viz": ".visualization",
     "monitor": ".monitor",
     "model": ".model",
-    "rnn": ".rnn_legacy",
+    "rnn": ".rnn",
     "operator": ".operator_custom",
     "contrib": ".contrib",
     "rtc": ".rtc",

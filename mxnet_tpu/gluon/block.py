@@ -49,6 +49,10 @@ class _BlockScope:
         if params is None:
             params = ParameterDict(prefix)
         else:
+            # Donor-prefix semantics: names resolve under the donor
+            # dict's prefix so its parameters are reused by name
+            # (reference block.py:_BlockScope.create —
+            # Dense(4, params=other.params) shares other's weight).
             params = ParameterDict(params.prefix, shared=params)
         return prefix, params
 
@@ -225,6 +229,8 @@ class HybridBlock(Block):
         self._cached_op_params = None
         self._cached_aux = {}
         self._cached_n_out = {}
+        self._cached_in_tree = None
+        self._cached_out_tree = {}
         self._flags = {}
 
     def hybridize(self, active=True, **kwargs):
@@ -279,11 +285,18 @@ class HybridBlock(Block):
         block = self
 
         def fn(*xs):
-            ps, ins = xs[:n], xs[n:]
+            from jax import tree_util as jtu
+
+            ps, flat_ins = xs[:n], xs[n:]
+            ins = jtu.tree_unflatten(block._cached_in_tree, list(flat_ins))
             ov = override(dict(zip(params, ps)))
             with ov:
                 out = block.forward(*ins)
-            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            # Outputs may be nested (e.g. RNN cells return
+            # (output, [states])); flatten to the executable's flat tuple
+            # and remember the structure for _call_cached_op.
+            outs, out_tree = jtu.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
             # Aux bookkeeping is per train-mode: the train and eval traces
             # are distinct executables with different aux writes (BatchNorm
             # updates running stats only in train mode).
@@ -291,17 +304,24 @@ class HybridBlock(Block):
             mode = autograd.is_training()
             block._cached_aux[mode] = aux
             block._cached_n_out[mode] = len(outs)
+            block._cached_out_tree[mode] = out_tree
             return tuple(outs) + tuple(ov.writes[p] for p in aux)
 
         self._cached_op = CachedOp(fn, num_params=n, **self._flags)
 
     def _call_cached_op(self, *args):
         """Reference: block.py:_call_cached_op → CachedOp::Forward."""
-        if self._cached_op is None:
+        from jax import tree_util as jtu
+
+        flat_args, in_tree = jtu.tree_flatten(
+            list(args), is_leaf=lambda x: isinstance(x, NDArray))
+        if self._cached_op is None or in_tree != self._cached_in_tree:
+            self._cached_in_tree = in_tree
             self._build_cache(*args)
-        ctx = next((a.context for a in args if isinstance(a, NDArray)), None)
+        ctx = next((a.context for a in flat_args
+                    if isinstance(a, NDArray)), None)
         param_data = [p.data(ctx) for p in self._cached_op_params]
-        result = self._cached_op(*(param_data + list(args)))
+        result = self._cached_op(*(param_data + flat_args))
         if not isinstance(result, tuple):
             result = (result,)
         mode = autograd.is_training()
@@ -310,7 +330,8 @@ class HybridBlock(Block):
         aux_vals = result[n_out:]
         for p, v in zip(self._cached_aux[mode], aux_vals):
             p.set_data(v)
-        return outs[0] if n_out == 1 else list(outs)
+        out = jtu.tree_unflatten(self._cached_out_tree[mode], list(outs))
+        return out
 
     def __call__(self, *args, **kwargs):
         if self._active and tracing_overrides() is None and \

@@ -20,6 +20,20 @@ __all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Xavier",
            "LSTMBias", "Mixed", "registry", "register"]
 
 registry = Registry("initializer")
+
+
+def _from_spec(spec):
+    """Recreate an initializer from a registry name or a dumps() JSON
+    string (reference: mx.init.create / legacy_json handling)."""
+    import json
+
+    if not isinstance(spec, str):
+        return spec
+    s = spec.strip()
+    if s.startswith("["):
+        name, kwargs = json.loads(s)
+        return registry.create(name, **kwargs)
+    return registry.create(s)
 register = registry.register
 
 
@@ -49,6 +63,16 @@ class Initializer:
     def __init__(self, **kwargs):
         self._kwargs = kwargs
 
+    def dumps(self):
+        """JSON string ["name", {kwargs}] (reference
+        initializer.py:Initializer.dumps — the form stored in symbol
+        __init__ attrs and kvstore set_optimizer payloads)."""
+        import json
+
+        name = getattr(self.__class__, "_register_name",
+                       self.__class__.__name__.lower())
+        return json.dumps([name, {k: v for k, v in self._kwargs.items()}])
+
     def __call__(self, desc, arr):
         if not isinstance(desc, InitDesc):
             desc = InitDesc(str(desc))
@@ -56,7 +80,7 @@ class Initializer:
         if init:
             if isinstance(init, Initializer):
                 return init._init_weight(desc, arr)
-            return registry.create(init)._init_weight(desc, arr)
+            return _from_spec(init)._init_weight(desc, arr)
         name = desc.lower()
         if name.endswith("weight"):
             return self._init_weight(desc, arr)
@@ -86,11 +110,6 @@ class Initializer:
     def _init_zero(self, desc, arr):
         arr[...] = 0.0
         return arr
-
-    def dumps(self):
-        import json
-
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
 
 
 @register("uniform")
@@ -246,3 +265,48 @@ class Mixed:
             if prog.match(str(desc)):
                 return init(desc, arr)
         raise ValueError("no initializer pattern matches %s" % desc)
+
+
+@register("fused_rnn")
+class FusedRNN(Initializer):
+    """Initialize a fused RNN op's flat parameter vector slice by slice
+    (reference: initializer.py:FusedRNN — unpacks, applies the wrapped
+    initializer per gate block, repacks). Weights get `init` (default
+    Uniform(0.07) like reference DEFAULT), biases zero with the LSTM
+    forget-gate slice set to `forget_bias`."""
+
+    def __init__(self, init=None, num_hidden=0, num_layers=1, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = _from_spec(init)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init or Uniform(0.07)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.rnn_ops import (rnn_infer_input_size, rnn_param_layout,
+                                  _NGATES)
+
+        flat = arr.reshape(-1)
+        h = self._num_hidden
+        in_sz = rnn_infer_input_size(flat.shape[0], self._num_layers, h,
+                                     self._mode, self._bidirectional)
+        for name, shape, off in rnn_param_layout(
+                self._num_layers, h, in_sz, self._mode, self._bidirectional):
+            n = int(np.prod(shape))
+            block = np.zeros(shape, dtype=arr.dtype)
+            if name.endswith("weight"):
+                self._init._init_weight(InitDesc(name), block)
+            elif self._mode == "lstm" and name.endswith("i2h_bias"):
+                # gate order [i, f, g, o]: forget slice is [h:2h]
+                block[h:2 * h] = self._forget_bias
+            flat[off:off + n] = block.reshape(-1)
+        return arr

@@ -163,6 +163,7 @@ class Module(BaseModule):
         self._arg_params = {}
         self._aux_params = {}
         ex = self._execs[0]
+        sym_attrs = self._symbol.attr_dict()
         for name in self._param_names:
             arr = ex.arg_dict[name]
             if arg_params is not None and name in arg_params:
@@ -171,7 +172,8 @@ class Module(BaseModule):
                 if arg_params is not None and not allow_missing:
                     raise RuntimeError("%s is not presented" % name)
                 init_arr = np.zeros(arr.shape, dtype=np.float32)
-                initializer(_init.InitDesc(name), init_arr)
+                initializer(_init.InitDesc(name, sym_attrs.get(name, {})),
+                            init_arr)
                 arr[:] = init_arr
             self._arg_params[name] = arr.copy()
         for name in self._aux_names:

@@ -698,8 +698,11 @@ def stack(*arrays, axis=0):
     return _invoke("stack", list(arrays), axis=axis)
 
 
-def split(ary, indices_or_sections, axis=0):
-    return _invoke("split", [ary], num_outputs=indices_or_sections, axis=axis)
+def split(ary, num_outputs=1, axis=0, squeeze_axis=False):
+    """Reference signature: mx.nd.split(data, num_outputs, axis,
+    squeeze_axis)."""
+    return _invoke("split", [ary], num_outputs=num_outputs, axis=axis,
+                   squeeze_axis=squeeze_axis)
 
 
 def moveaxis(tensor, source, destination):

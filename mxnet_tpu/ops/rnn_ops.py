@@ -1,0 +1,221 @@
+"""Fused RNN operator (vanilla/tanh, LSTM, GRU; multi-layer; bidirectional).
+
+Reference: src/operator/rnn.cc + rnn-inl.h:349 (CPU kernels in
+rnn_impl.h) and the cuDNN path (src/operator/cudnn_rnn-inl.h:41-196,
+`cudnnRNNForwardTraining`). Parameter/gate layout follows the reference's
+cuDNN convention: all layer weights first (per layer, per direction:
+W_input, W_hidden), then all biases (b_input, b_hidden); gate order
+LSTM = [i, f, g, o], GRU = [r, z, n].
+
+TPU rebuild: one `lax.scan` per (layer, direction) carries the recurrent
+state; the input-to-hidden projection for the WHOLE sequence is hoisted
+out of the scan into a single (T*N, I) x (I, G*H) matmul so the MXU sees
+one large GEMM per layer instead of T small ones. Only the h-side
+(H x G*H) GEMM stays inside the scan — the irreducible serial
+dependency. Gradients come from JAX autodiff through the scan (the
+reference hand-writes the backward in rnn_impl.h / relies on
+cudnnRNNBackward*). Bidirectional layers run a second, reversed scan and
+concatenate features. Inter-layer dropout (train only) matches cuDNN's
+placement: applied to every layer's input except the first.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+_NGATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def rnn_param_size(num_layers, state_size, input_size, mode="lstm",
+                   bidirectional=False, projection_size=None):
+    """Total flat parameter count (reference rnn-inl.h:GetRnnParamSize)."""
+    ngates = _NGATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * d
+        # per direction: W_i (G*H, in), W_h (G*H, H), b_i (G*H), b_h (G*H)
+        size += d * (ngates * h * (in_sz + h) + 2 * ngates * h)
+    return size
+
+
+def rnn_infer_input_size(total_size, num_layers, state_size, mode="lstm",
+                         bidirectional=False):
+    """Invert rnn_param_size for the input width given a flat vector's
+    length (used by initializer.FusedRNN and unpack_weights)."""
+    ngates = _NGATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    rest = total_size
+    for layer in range(1, num_layers):
+        rest -= d * (ngates * h * (h * d + h) + 2 * ngates * h)
+    rest -= d * (ngates * h * h + 2 * ngates * h)
+    in_sz = rest // (d * ngates * h)
+    if rnn_param_size(num_layers, state_size, in_sz, mode,
+                      bidirectional) != total_size:
+        raise ValueError("parameter vector of length %d does not match "
+                         "any input size for this RNN config" % total_size)
+    return in_sz
+
+
+def rnn_param_layout(num_layers, state_size, input_size, mode="lstm",
+                     bidirectional=False):
+    """[(name, shape, offset)] into the flat parameter vector — weights
+    for every (layer, direction) first, then all biases (the cuDNN /
+    reference rnn-inl.h ordering)."""
+    ngates = _NGATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    layout = []
+    off = 0
+    # Names follow reference gluon/rnn/rnn_layer.py: forward direction
+    # 'l<layer>_', reverse direction 'r<layer>_' — so exported parameter
+    # dicts line up with reference checkpoints.
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * d
+        for dr in range(d):
+            sfx = ("r%d" if dr else "l%d") % layer
+            layout.append(("%s_i2h_weight" % sfx, (ngates * h, in_sz), off))
+            off += ngates * h * in_sz
+            layout.append(("%s_h2h_weight" % sfx, (ngates * h, h), off))
+            off += ngates * h * h
+    for layer in range(num_layers):
+        for dr in range(d):
+            sfx = ("r%d" if dr else "l%d") % layer
+            layout.append(("%s_i2h_bias" % sfx, (ngates * h,), off))
+            off += ngates * h
+            layout.append(("%s_h2h_bias" % sfx, (ngates * h,), off))
+            off += ngates * h
+    return layout
+
+
+def _unpack_params(parameters, num_layers, state_size, input_size, mode,
+                   bidirectional):
+    """flat -> {name: array} with static offsets (shapes are static under
+    jit, so plain slicing compiles to free bitcasts)."""
+    out = {}
+    for name, shape, off in rnn_param_layout(num_layers, state_size,
+                                             input_size, mode, bidirectional):
+        n = int(np.prod(shape))
+        out[name] = parameters[off:off + n].reshape(shape)
+    return out
+
+
+def _scan_direction(x, h0, c0, wi, wh, bi, bh, mode, reverse):
+    """One directional pass over (T, N, in). Returns (out (T,N,H), hT, cT).
+
+    The x-side projection is one hoisted GEMM; `lax.scan` carries h (and
+    c for LSTM) with only the h-side GEMM inside.
+    """
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    # (T, N, G*H) — single large MXU matmul for the whole sequence; only
+    # the input-side bias is hoisted (GRU's h-side bias must stay inside
+    # the r-gate product, so all modes keep bh in the step for uniformity;
+    # XLA fuses the broadcast add into the GEMM epilogue).
+    xg = jnp.einsum("tni,gi->tng", x, wi) + bi
+    if reverse:
+        xg = jnp.flip(xg, axis=0)
+
+    if mode == "lstm":
+        def step(carry, xg_t):
+            h, c = carry
+            gates = xg_t + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            c_new = f * c + i * jnp.tanh(g)
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (h_t, c_t), ys = lax.scan(step, (h0, c0), xg)
+    elif mode == "gru":
+        # cuDNN GRU: n = tanh(W_n x + b_Wn + r * (R_n h + b_Rn)) — the
+        # h-side new-gate term is gated by r before the add.
+        def step(h, xg_t):
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            new = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * new + z * h
+            return h_new, h_new
+
+        h_t, ys = lax.scan(step, h0, xg)
+        c_t = c0
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def step(h, xg_t):
+            h_new = act(xg_t + h @ wh.T + bh)
+            return h_new, h_new
+
+        h_t, ys = lax.scan(step, h0, xg)
+        c_t = c0
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, h_t, c_t
+
+
+@register("RNN", needs_rng=True, train_aware=True)
+def _rnn(rng_key, data, parameters, state, state_cell=None, state_size=0,
+         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+         state_outputs=False, training=False, projection_size=None,
+         lstm_state_clip_min=None, lstm_state_clip_max=None, **_ignored):
+    """Fused multi-layer RNN (reference src/operator/rnn.cc).
+
+    data: (T, N, input); parameters: flat vector (see rnn_param_layout);
+    state: (L*D, N, H); state_cell (lstm): (L*D, N, H).
+    Returns out (T,N,D*H) or (out, state_out[, statecell_out]).
+    """
+    import jax
+
+    jnp = _jnp()
+    t, n, input_size = data.shape
+    d = 2 if bidirectional else 1
+    h = int(state_size)
+    params = _unpack_params(parameters, num_layers, h, input_size, mode,
+                            bidirectional)
+    if state_cell is None:
+        state_cell = jnp.zeros_like(state)
+
+    x = data
+    h_outs, c_outs = [], []
+    for layer in range(num_layers):
+        if layer > 0 and p > 0 and training:
+            rng_key, sub = jax.random.split(rng_key)
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(sub, keep, x.shape).astype(x.dtype)
+            x = x * mask / np.asarray(keep, x.dtype)
+        ys = []
+        for dr in range(d):
+            sfx = ("r%d" if dr else "l%d") % layer
+            row = layer * d + dr
+            y, h_t, c_t = _scan_direction(
+                x, state[row], state_cell[row],
+                params["%s_i2h_weight" % sfx], params["%s_h2h_weight" % sfx],
+                params["%s_i2h_bias" % sfx], params["%s_h2h_bias" % sfx],
+                mode, reverse=bool(dr))
+            if mode == "lstm" and lstm_state_clip_min is not None:
+                c_t = jnp.clip(c_t, lstm_state_clip_min, lstm_state_clip_max)
+            ys.append(y)
+            h_outs.append(h_t)
+            c_outs.append(c_t)
+        x = jnp.concatenate(ys, axis=-1) if d > 1 else ys[0]
+
+    if not state_outputs:
+        return x
+    state_out = jnp.stack(h_outs, axis=0)
+    if mode == "lstm":
+        return x, state_out, jnp.stack(c_outs, axis=0)
+    return x, state_out

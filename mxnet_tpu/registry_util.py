@@ -17,11 +17,17 @@ class Registry:
 
             def deco(cls):
                 self._registry[reg_name] = cls
+                # first registration wins as canonical (for dumps())
+                if not hasattr(cls, "_register_name"):
+                    cls._register_name = reg_name
                 return cls
 
             return deco
         cls = name_or_cls
-        self._registry[(name or cls.__name__).lower()] = cls
+        reg_name = (name or cls.__name__).lower()
+        self._registry[reg_name] = cls
+        if not hasattr(cls, "_register_name"):
+            cls._register_name = reg_name
         return cls
 
     def create(self, name, *args, **kwargs):

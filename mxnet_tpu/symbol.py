@@ -119,9 +119,15 @@ def _rnn_shapes(attrs, dshape):
     # dshape (T, N, input); total fused param size per rnn op spec.
     from .ops.rnn_ops import rnn_param_size
 
+    num_layers = int(attrs["num_layers"])
+    state_size = int(attrs["state_size"])
+    bidir = bool(attrs.get("bidirectional", False))
+    d = 2 if bidir else 1
+    state_shape = (num_layers * d, dshape[1], state_size)
     return {"parameters": (rnn_param_size(
-        int(attrs["num_layers"]), int(attrs["state_size"]), dshape[2],
-        attrs.get("mode", "lstm"), bool(attrs.get("bidirectional", False))),)}
+        num_layers, state_size, dshape[2],
+        attrs.get("mode", "lstm"), bidir),),
+        "state": state_shape, "state_cell": state_shape}
 
 
 _PARAM_SHAPE_RULES = {
@@ -175,9 +181,11 @@ class Symbol:
             self._attrs["__%s__" % k] = v
 
     def attr_dict(self):
+        """Per-node user attributes, dunder keys preserved (reference
+        symbol.py:attr_dict — initializer.__call__ reads `__init__`)."""
         out = {}
         for node in self._topo():
-            d = {k[2:-2]: v for k, v in node._attrs.items()
+            d = {k: v for k, v in node._attrs.items()
                  if k.startswith("__") and k.endswith("__")}
             if d and node._name:
                 out[node._name] = d
@@ -246,6 +254,20 @@ class Symbol:
             return Symbol(self._op, self._attrs, self._inputs, self._name,
                           out_index=index, num_outputs=self._num_outputs)
         raise TypeError(index)
+
+    def __len__(self):
+        if self._op == "_group":
+            return len(self._inputs)
+        if self._out_index is not None:
+            raise TypeError("single-output Symbol has no len()")
+        return self._num_outputs
+
+    def __iter__(self):
+        if self._op == "_group":
+            return iter(self._inputs)
+        if self._num_outputs == 1 or self._out_index is not None:
+            raise TypeError("cannot iterate a single-output Symbol")
+        return (self[i] for i in range(self._num_outputs))
 
     @property
     def outputs(self):
@@ -443,12 +465,6 @@ class Symbol:
         ex = self.bind(ctx, args=kwargs, grad_req="null")
         return ex.forward(is_train=False)
 
-    # numpy-style conveniences used by module code
-    def __iter__(self):
-        return iter(self.outputs)
-
-    def __len__(self):
-        return len(self.outputs)
 
 
 def _jsonify_attrs(attrs):
@@ -458,6 +474,9 @@ def _jsonify_attrs(attrs):
             v = v.tolist()
         elif isinstance(v, tuple):
             v = list(v)
+        elif not isinstance(v, (str, int, float, bool, list, dict,
+                                type(None))):
+            v = str(v)  # last-resort: keep the graph serializable
         out[k] = v
     return out
 
@@ -474,6 +493,15 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         s._attrs["__lr_mult__"] = lr_mult
     if wd_mult is not None:
         s._attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        # Store the JSON spec, not the object, so tojson()/save() stay
+        # serializable (reference stores init.dumps()).
+        s._attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    if dtype is not None:
+        s._attrs["__dtype__"] = str(np.dtype(dtype).name) \
+            if not isinstance(dtype, str) else dtype
+    if stype is not None:
+        s._attrs["__storage_type__"] = stype
     return s
 
 
@@ -538,6 +566,20 @@ def _invoke_sym(op_name, lhs, rhs):
 _SYM_FUNC_CACHE = {}
 
 
+# Ops whose visible output count depends on attrs (reference: each
+# NNVM op declares num_outputs; here a small rule table at the seam).
+_NUM_OUTPUT_RULES = {
+    "split": lambda a: int(a.get("num_outputs", 1)),
+    "SliceChannel": lambda a: int(a.get("num_outputs", 1)),
+    "slice_channel": lambda a: int(a.get("num_outputs", 1)),
+    "RNN": lambda a: (3 if a.get("mode", "lstm") == "lstm" else 2)
+    if a.get("state_outputs") else 1,
+    "LayerNorm": lambda a: 3 if a.get("output_mean_var") else 1,
+    "layer_norm": lambda a: 3 if a.get("output_mean_var") else 1,
+    "topk": lambda a: 2 if a.get("ret_typ") == "both" else 1,
+}
+
+
 def _make_symbol_op(op_name):
     """Build the symbolic composer for a registered op: Symbols in
     args/kwargs become node inputs; scalars become attrs; missing
@@ -595,7 +637,8 @@ def _make_symbol_op(op_name):
         node_attrs["_op_name"] = op_name
         if attr:
             node_attrs.update({"__%s__" % k: v for k, v in attr.items()})
-        n_out = 2 if op_name in ("RNN",) else 1
+        rule = _NUM_OUTPUT_RULES.get(op_name)
+        n_out = rule(node_attrs) if rule else 1
         return Symbol(op_name, attrs=node_attrs, inputs=ordered + extra,
                       name=name_, num_outputs=n_out)
 

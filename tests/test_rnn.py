@@ -1,0 +1,320 @@
+"""RNN stack tests (reference: tests/python/unittest/test_gluon_rnn.py,
+test_rnn.py, test_operator.py RNN cases)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.ops.rnn_ops import rnn_param_size, rnn_param_layout
+
+
+def test_rnn_param_size_matches_layout():
+    for mode in ("rnn_relu", "lstm", "gru"):
+        for bidir in (False, True):
+            size = rnn_param_size(3, 7, 5, mode, bidir)
+            layout = rnn_param_layout(3, 7, 5, mode, bidir)
+            last_name, last_shape, last_off = layout[-1]
+            assert last_off + int(np.prod(last_shape)) == size
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "lstm", "gru"])
+def test_fused_op_shapes(mode):
+    T, N, I, H, L = 4, 2, 3, 5, 2
+    psz = rnn_param_size(L, H, I, mode)
+    out = mx.nd.RNN(mx.nd.random.normal(0, 1, shape=(T, N, I)),
+                    mx.nd.random.normal(0, 0.1, shape=(psz,)),
+                    mx.nd.zeros((L, N, H)),
+                    *([mx.nd.zeros((L, N, H))] if mode == "lstm" else []),
+                    state_size=H, num_layers=L, mode=mode,
+                    state_outputs=True)
+    assert out[0].shape == (T, N, H)
+    assert out[1].shape == (L, N, H)
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh"])
+def test_fused_equals_unfused(mode):
+    """The fused lax.scan kernel and the per-step cells share parameters
+    via unfuse() and must agree numerically (reference
+    test_gluon_rnn.py:check_rnn_consistency)."""
+    layer_cls = {"lstm": gluon.rnn.LSTM, "gru": gluon.rnn.GRU,
+                 "rnn_tanh": lambda h, **kw: gluon.rnn.RNN(
+                     h, activation="tanh", **kw)}[mode]
+    layer = layer_cls(8, num_layers=2)
+    layer.initialize(mx.initializer.Xavier())
+    x = mx.nd.random.normal(0, 1, shape=(6, 3, 4))
+    fused_out = layer(x)
+    stack = layer.unfuse()
+    # params are shared by construction; no copying needed
+    unfused_out, _ = stack.unroll(6, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(fused_out.asnumpy(), unfused_out.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_equals_unfused_bidirectional():
+    layer = gluon.rnn.LSTM(5, num_layers=1, bidirectional=True)
+    layer.initialize(mx.initializer.Xavier())
+    x = mx.nd.random.normal(0, 1, shape=(4, 2, 3))
+    fused_out = layer(x)
+    unfused_out, _ = layer.unfuse().unroll(4, x, layout="TNC",
+                                           merge_outputs=True)
+    np.testing.assert_allclose(fused_out.asnumpy(), unfused_out.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_autograd_and_training():
+    """A tiny LSTM regressor must fit a memorization task — exercises
+    gradient flow through the scan."""
+    T, N, I, H = 5, 8, 3, 16
+    rng = np.random.RandomState(0)
+    X = rng.randn(T, N, I).astype(np.float32)
+    target = rng.randn(N, 1).astype(np.float32)
+
+    net = gluon.rnn.LSTM(H)
+    dense = gluon.nn.Dense(1)
+    net.initialize(mx.initializer.Xavier())
+    dense.initialize(mx.initializer.Xavier())
+    params = net.collect_params()
+    params.update(dense.collect_params())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.02})
+    loss_fn = gluon.loss.L2Loss()
+    xs = mx.nd.array(X)
+    ys = mx.nd.array(target)
+    first = None
+    for i in range(60):
+        with mx.autograd.record():
+            h = net(xs)          # (T, N, H)
+            last = h[-1]         # (N, H)
+            out = dense(last)
+            loss = loss_fn(out, ys).mean()
+        loss.backward()
+        trainer.step(1)
+        v = float(loss.asscalar())
+        if first is None:
+            first = v
+    assert v < first * 0.1, "LSTM failed to fit: %.4f -> %.4f" % (first, v)
+
+
+def test_gluon_rnn_save_load(tmp_path):
+    layer = gluon.rnn.GRU(7, num_layers=2, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.random.normal(0, 1, shape=(4, 2, 3))
+    out1 = layer(x)
+    f = str(tmp_path / "gru.params")
+    layer.save_parameters(f)
+    layer2 = gluon.rnn.GRU(7, num_layers=2, bidirectional=True)
+    layer2.load_parameters(f)
+    out2 = layer2(x)
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), rtol=1e-6)
+
+
+def test_rnn_dropout_modes():
+    layer = gluon.rnn.LSTM(8, num_layers=2, dropout=0.5)
+    layer.initialize()
+    x = mx.nd.ones((4, 2, 3))
+    eval_out1 = layer(x).asnumpy()
+    eval_out2 = layer(x).asnumpy()
+    np.testing.assert_allclose(eval_out1, eval_out2)  # eval: deterministic
+    with mx.autograd.record(train_mode=True):
+        train_out1 = layer(x).asnumpy()
+        train_out2 = layer(x).asnumpy()
+    assert not np.allclose(train_out1, train_out2)  # train: stochastic
+
+
+def test_hybridized_cell_unroll():
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    x = mx.nd.random.normal(0, 1, shape=(3, 5, 4))  # NTC
+    out_e, st_e = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    cell.hybridize()
+    out_h, st_h = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    np.testing.assert_allclose(out_e.asnumpy(), out_h.asnumpy(), rtol=1e-5)
+
+
+def test_legacy_symbolic_cells():
+    data = mx.sym.Variable("data")
+    cell = mx.rnn.LSTMCell(8, prefix="lstm_")
+    outputs, _ = cell.unroll(5, data, layout="NTC", merge_outputs=True)
+    ex = outputs.simple_bind(ctx=mx.cpu(), data=(3, 5, 4))
+    ex.arg_dict["data"][:] = np.random.randn(3, 5, 4).astype(np.float32)
+    assert ex.forward()[0].shape == (3, 5, 8)
+
+
+def test_legacy_fused_cell_pack_unpack():
+    fcell = mx.rnn.FusedRNNCell(6, num_layers=2, mode="lstm",
+                                prefix="lstm_")
+    data = mx.sym.Variable("data")
+    out, _ = fcell.unroll(4, data, layout="NTC", merge_outputs=True)
+    ex = out.simple_bind(ctx=mx.cpu(), data=(2, 4, 3))
+    args = {"lstm_parameters": ex.arg_dict["lstm_parameters"].copy()}
+    unpacked = fcell.unpack_weights(args)
+    assert "lstm_l0_i2h_weight" in unpacked
+    assert unpacked["lstm_l0_i2h_weight"].shape == (24, 3)
+    repacked = fcell.pack_weights(unpacked)
+    np.testing.assert_allclose(
+        repacked["lstm_parameters"].asnumpy(),
+        args.get("lstm_parameters",
+                 ex.arg_dict["lstm_parameters"]).asnumpy())
+
+
+def test_bucket_sentence_iter():
+    sents = [[1, 2, 3], [4, 5], [1, 2, 3, 4, 5, 6], [7, 8, 9], [2, 3],
+             [5, 6, 7], [9, 9, 9, 9]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[3, 6])
+    seen = set()
+    for b in it:
+        seen.add(b.bucket_key)
+        assert b.data[0].shape == (2, b.bucket_key)
+        # label is input shifted left by one
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+    assert 3 in seen
+
+
+def test_encode_sentences():
+    from mxnet_tpu.rnn import encode_sentences
+
+    coded, vocab = encode_sentences([["a", "b"], ["b", "c"]])
+    assert len(vocab) >= 3
+    assert coded[0][1] == coded[1][0]  # "b" consistent
+
+
+def test_bucketing_module_lstm_lm():
+    """LSTM LM through BucketingModule — the reference's north-star
+    bucketing use-case now runs (VERDICT r1 §5.7)."""
+    from mxnet_tpu.module import BucketingModule
+
+    V, E, H = 20, 8, 16
+    rng = np.random.RandomState(0)
+    sents = [list(rng.randint(1, V, size=rng.choice([3, 6]))) for _ in
+             range(64)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=8, buckets=[3, 6])
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                                 name="embed")
+        cell = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                   prefix="lstm_")
+        output, _ = cell.unroll(seq_len, embed, layout="NTC",
+                                merge_outputs=True)
+        pred = mx.sym.reshape(output, shape=(-1, H))
+        pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+        label_flat = mx.sym.reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, label_flat, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=6, context=mx.cpu())
+    from mxnet_tpu.io import DataDesc
+
+    mod.bind(data_shapes=[DataDesc("data", (8, 6))],
+             label_shapes=[DataDesc("softmax_label", (8, 6))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    losses = []
+    for epoch in range(3):
+        it.reset()
+        tot, n = 0.0, 0
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            out = mod.get_outputs()[0].asnumpy()
+            labels = batch.label[0].asnumpy().reshape(-1).astype(int)
+            probs = out[np.arange(len(labels)), labels]
+            tot += -np.log(np.maximum(probs, 1e-9)).mean()
+            n += 1
+            mod.backward()
+            mod.update()
+        losses.append(tot / n)
+    assert losses[-1] < losses[0], "LM loss did not drop: %s" % losses
+
+
+def test_unroll_valid_length():
+    """Masking + true-last-state semantics (reference
+    test_gluon_rnn.py:test_cell_fill_shape / valid_length cases)."""
+    cell = gluon.rnn.LSTMCell(6)
+    cell.initialize()
+    x = mx.nd.random.normal(0, 1, shape=(3, 5, 4))  # NTC
+    vl = mx.nd.array([2, 5, 3])
+    out, states = cell.unroll(5, x, layout="NTC", merge_outputs=True,
+                              valid_length=vl)
+    o = out.asnumpy()  # (N, T, C)
+    assert o.shape == (3, 5, 6)
+    assert np.allclose(o[0, 2:], 0) and np.allclose(o[2, 3:], 0)
+    assert not np.allclose(o[0, 1], 0)
+    # state equals the hidden at the true last step
+    full_out, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    np.testing.assert_allclose(states[0].asnumpy()[0],
+                               full_out.asnumpy()[0, 1], rtol=1e-5)
+
+
+def test_bidirectional_valid_length_ignores_padding():
+    """Reverse direction must not consume padding (r1 review finding)."""
+    lcell, rcell = gluon.rnn.RNNCell(4), gluon.rnn.RNNCell(4)
+    bi = gluon.rnn.BidirectionalCell(lcell, rcell)
+    bi.initialize()
+    T = 6
+    x = mx.nd.random.normal(0, 1, shape=(2, T, 3))
+    vl = mx.nd.array([3, 6])
+    out, _ = bi.unroll(T, x, layout="NTC", merge_outputs=True,
+                       valid_length=vl)
+    # Corrupt the padding of sequence 0; valid outputs must not change.
+    x2 = x.asnumpy().copy()
+    x2[0, 3:] = 77.0
+    out2, _ = bi.unroll(T, mx.nd.array(x2), layout="NTC",
+                        merge_outputs=True, valid_length=vl)
+    np.testing.assert_allclose(out.asnumpy()[0, :3],
+                               out2.asnumpy()[0, :3], rtol=1e-5)
+
+
+def test_legacy_graph_json_serializable(tmp_path):
+    """Init-carrying variables must not break tojson (r1 review
+    finding: Initializer objects in __init__ attrs)."""
+    data = mx.sym.Variable("data")
+    cell = mx.rnn.LSTMCell(4, prefix="lstm_")
+    out, _ = cell.unroll(3, data, layout="NTC", merge_outputs=True)
+    js = out.tojson()
+    assert "lstm_" in js
+    fcell = mx.rnn.FusedRNNCell(4, mode="lstm", prefix="flstm_")
+    fout, _ = fcell.unroll(3, data, layout="NTC", merge_outputs=True)
+    fout.save(str(tmp_path / "f.json"))
+    loaded = mx.sym.load(str(tmp_path / "f.json"))
+    assert "flstm_parameters" in loaded.list_arguments()
+
+
+def test_shared_params_donor_semantics():
+    """Dense(params=other.params) must share the donor's weight
+    (reference parameter-sharing semantics; r1 review finding)."""
+    d0 = gluon.nn.Dense(4, in_units=3)
+    d1 = gluon.nn.Dense(4, in_units=3, params=d0.collect_params())
+    d0.initialize()
+    x = mx.nd.random.normal(0, 1, shape=(2, 3))
+    np.testing.assert_allclose(d0(x).asnumpy(), d1(x).asnumpy())
+    assert d1.weight is d0.weight or \
+        d1.params.get("weight") is d0.params.get("weight")
+
+
+def test_fused_rnn_initializer():
+    from mxnet_tpu.initializer import FusedRNN, InitDesc, Uniform
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size, rnn_param_layout
+
+    H, I, L = 4, 3, 2
+    init = FusedRNN(Uniform(0.1), num_hidden=H, num_layers=L, mode="lstm")
+    arr = np.zeros((rnn_param_size(L, H, I, "lstm"),), np.float32)
+    init(InitDesc("lstm_parameters"), arr)
+    # forget-gate bias slice == 1.0, other bias entries 0, weights nonzero
+    for name, shape, off in rnn_param_layout(L, H, I, "lstm"):
+        n = int(np.prod(shape))
+        blk = arr[off:off + n].reshape(shape)
+        if name.endswith("i2h_bias"):
+            assert np.allclose(blk[H:2 * H], 1.0)
+            assert np.allclose(blk[:H], 0.0)
+        elif name.endswith("weight"):
+            assert np.abs(blk).max() > 0
+    # round-trips through dumps
+    spec = init.dumps()
+    from mxnet_tpu.initializer import _from_spec
+
+    init2 = _from_spec(spec)
+    assert init2._num_hidden == H
