@@ -1,0 +1,214 @@
+"""DataLoader with multiprocess workers.
+
+Reference: python/mxnet/gluon/data/dataloader.py:26-112 (worker pool +
+shared-memory NDArray rebuild, default_batchify_fn, _MultiWorkerIter).
+
+TPU rebuild: workers are forked processes that run ONLY host-side numpy
+code (dataset indexing, decode, augment, batchify) — they never touch
+the TPU client, the fork-safety contract the reference enforces with
+pthread_atfork engine quiesce (src/initialize.cc:52; SURVEY.md §7 hard
+parts). Batches cross the process boundary as numpy arrays and are
+placed on device once, in the consumer process, as one contiguous
+transfer per stream. Worker exceptions are captured and re-raised at
+`next()` like the reference's prefetcher (docs/architecture/
+exception_handling.md).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import traceback
+import weakref
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py:
+    default_batchify_fn). Output stays numpy until device placement."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return data
+
+
+# Workers return numpy (picklable, no device handles); same function
+# serves both sides here — kept as a distinct name for reference parity.
+default_mp_batchify_fn = default_batchify_fn
+
+
+class _WorkerError:
+    """Pickled traceback from a worker (re-raised in the consumer)."""
+
+    def __init__(self, exc):
+        self.exc_type = type(exc).__name__
+        self.msg = str(exc)
+        self.tb = traceback.format_exc()
+
+    def reraise(self):
+        raise RuntimeError(
+            "DataLoader worker raised %s: %s\n--- worker traceback ---\n%s"
+            % (self.exc_type, self.msg, self.tb))
+
+
+_worker_dataset = None
+
+
+def _terminate_pool(pool):
+    try:
+        pool.terminate()
+        pool.join()
+    except Exception:
+        pass
+
+
+def _worker_initializer(dataset):
+    # Dataset is sent once at pool startup, not per batch (reference
+    # dataloader.py:worker_loop receives the dataset through the fork).
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn):
+    try:
+        batch = batchify_fn([_worker_dataset[i] for i in samples])
+        return _as_numpy(batch)
+    except Exception as e:  # captured, not fatal to the pool
+        return _WorkerError(e)
+
+
+def _as_numpy(batch):
+    if isinstance(batch, NDArray):
+        return batch.asnumpy()
+    if isinstance(batch, (list, tuple)):
+        return [_as_numpy(b) for b in batch]
+    return batch
+
+
+def _to_ndarray(batch, pin=False):
+    if isinstance(batch, np.ndarray):
+        return nd.array(batch)
+    if isinstance(batch, (list, tuple)):
+        return [_to_ndarray(b) for b in batch]
+    return batch
+
+
+class _MultiWorkerIter:
+    """Async iterator over a worker pool with bounded prefetch
+    (reference dataloader.py:_MultiWorkerIter)."""
+
+    def __init__(self, pool, batchify_fn, batch_sampler, prefetch):
+        self._pool = pool
+        self._batchify_fn = batchify_fn
+        self._iter = iter(batch_sampler)
+        self._data_buffer = {}
+        self._rcvd_idx = 0
+        self._sent_idx = 0
+        for _ in range(prefetch):
+            self._push_next()
+
+    def _push_next(self):
+        r = next(self._iter, None)
+        if r is None:
+            return
+        async_ret = self._pool.apply_async(_worker_fn,
+                                           (r, self._batchify_fn))
+        self._data_buffer[self._sent_idx] = async_ret
+        self._sent_idx += 1
+
+    def __next__(self):
+        self._push_next()
+        if self._rcvd_idx == self._sent_idx:
+            assert not self._data_buffer, \
+                "Data buffer should be empty at this moment"
+            raise StopIteration
+        ret = self._data_buffer.pop(self._rcvd_idx)
+        self._rcvd_idx += 1
+        batch = ret.get()
+        if isinstance(batch, _WorkerError):
+            batch = batch.reraise()
+        return _to_ndarray(batch)
+
+    def __iter__(self):
+        return self
+
+
+class DataLoader:
+    """Mini-batch loader over a Dataset (reference dataloader.py:
+    DataLoader).
+
+    Parameters follow the reference: dataset, batch_size, shuffle,
+    sampler, last_batch, batch_sampler, batchify_fn, num_workers.
+    """
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 prefetch=None, thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+
+                self._pool = ThreadPool(
+                    self._num_workers,
+                    initializer=_worker_initializer, initargs=(dataset,))
+            else:
+                ctx = mp.get_context("fork")
+                self._pool = ctx.Pool(
+                    self._num_workers,
+                    initializer=_worker_initializer, initargs=(dataset,))
+            # finalize() runs at gc or atexit — BEFORE interpreter
+            # teardown, unlike __del__, so the pool shuts down while
+            # multiprocessing internals are still alive.
+            self._finalizer = weakref.finalize(self, _terminate_pool,
+                                               self._pool)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            def same_process_iter():
+                for batch in self._batch_sampler:
+                    yield _to_ndarray(_as_numpy(self._batchify_fn(
+                        [self._dataset[idx] for idx in batch])))
+            return same_process_iter()
+        return _MultiWorkerIter(self._pool, self._batchify_fn,
+                                self._batch_sampler, self._prefetch)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+

@@ -462,6 +462,16 @@ class ResidualCell(ModifierCell):
             merge_outputs=True, valid_length=valid_length)
         self.base_cell._modified = True
         merged, axis, _ = _format_sequence(length, inputs, layout, True)
+        if valid_length is not None:
+            # Keep the zero-padding invariant: mask the inputs too before
+            # the residual add (reference rnn_cell.py:ResidualCell.unroll).
+            vl_axis = 0 if axis == 0 else 1
+            if vl_axis == 1:
+                merged = nd.transpose(merged, axes=(1, 0, 2))
+            merged = nd.SequenceMask(merged, sequence_length=valid_length,
+                                     use_sequence_length=True, axis=0)
+            if vl_axis == 1:
+                merged = nd.transpose(merged, axes=(1, 0, 2))
         outputs = outputs + merged
         if merge_outputs is False:
             outputs = [o.reshape(tuple(d for i, d in enumerate(o.shape)

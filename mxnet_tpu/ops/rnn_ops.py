@@ -109,7 +109,7 @@ def _unpack_params(parameters, num_layers, state_size, input_size, mode,
     return out
 
 
-def _scan_direction(x, h0, c0, wi, wh, bi, bh, mode, reverse):
+def _scan_direction(x, h0, c0, wi, wh, bi, bh, mode, reverse, clip=None):
     """One directional pass over (T, N, in). Returns (out (T,N,H), hT, cT).
 
     The x-side projection is one hoisted GEMM; `lax.scan` carries h (and
@@ -134,6 +134,10 @@ def _scan_direction(x, h0, c0, wi, wh, bi, bh, mode, reverse):
             i, f, g, o = jnp.split(gates, 4, axis=-1)
             i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
             c_new = f * c + i * jnp.tanh(g)
+            if clip is not None:
+                # reference clips c INSIDE every step (rnn_impl.h /
+                # cudnnRNNForward with cell clip), not just at the end
+                c_new = jnp.clip(c_new, clip[0], clip[1])
             h_new = o * jnp.tanh(c_new)
             return (h_new, c_new), h_new
 
@@ -201,13 +205,14 @@ def _rnn(rng_key, data, parameters, state, state_cell=None, state_size=0,
         for dr in range(d):
             sfx = ("r%d" if dr else "l%d") % layer
             row = layer * d + dr
+            clip = (lstm_state_clip_min, lstm_state_clip_max) \
+                if (mode == "lstm" and lstm_state_clip_min is not None) \
+                else None
             y, h_t, c_t = _scan_direction(
                 x, state[row], state_cell[row],
                 params["%s_i2h_weight" % sfx], params["%s_h2h_weight" % sfx],
                 params["%s_i2h_bias" % sfx], params["%s_h2h_bias" % sfx],
-                mode, reverse=bool(dr))
-            if mode == "lstm" and lstm_state_clip_min is not None:
-                c_t = jnp.clip(c_t, lstm_state_clip_min, lstm_state_clip_max)
+                mode, reverse=bool(dr), clip=clip)
             ys.append(y)
             h_outs.append(h_t)
             c_outs.append(c_t)

@@ -17,8 +17,10 @@ class Registry:
 
             def deco(cls):
                 self._registry[reg_name] = cls
-                # first registration wins as canonical (for dumps())
-                if not hasattr(cls, "_register_name"):
+                # first registration wins as canonical (for dumps());
+                # __dict__ check so subclasses don't inherit the parent's
+                # registry name
+                if "_register_name" not in cls.__dict__:
                     cls._register_name = reg_name
                 return cls
 
@@ -26,7 +28,7 @@ class Registry:
         cls = name_or_cls
         reg_name = (name or cls.__name__).lower()
         self._registry[reg_name] = cls
-        if not hasattr(cls, "_register_name"):
+        if "_register_name" not in cls.__dict__:
             cls._register_name = reg_name
         return cls
 

@@ -357,8 +357,6 @@ class FusedRNNCell(BaseRNNCell):
         import numpy as np
 
         args = dict(args)
-        names = [k for k in args if k.startswith(self._prefix) and
-                 ("i2h" in k or "h2h" in k)]
         w0 = args[self._prefix + "l0_i2h_weight"]
         in_sz = w0.shape[1]
         total = rnn_param_size(self._num_layers, self._num_hidden, in_sz,

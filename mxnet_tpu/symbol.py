@@ -246,6 +246,9 @@ class Symbol:
                         return s
                 raise ValueError("Cannot find output %r" % index)
             return self._inputs[index]
+        if isinstance(index, slice):
+            return [self[i] for i in range(*index.indices(
+                self._num_outputs))]
         if isinstance(index, int):
             if self._num_outputs == 1:
                 if index != 0:

@@ -1,0 +1,193 @@
+"""gluon.data tests (reference: tests/python/unittest/test_gluon_data.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, recordio
+from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                  RandomSampler, RecordFileDataset,
+                                  SequentialSampler, SimpleDataset)
+from mxnet_tpu.gluon.data.vision import (CIFAR10, MNIST, ImageRecordDataset,
+                                         transforms)
+
+
+def test_array_dataset_and_transform():
+    X = np.random.rand(10, 3).astype(np.float32)
+    Y = np.arange(10)
+    ds = ArrayDataset(X, Y)
+    assert len(ds) == 10
+    x, y = ds[3]
+    assert np.allclose(x, X[3]) and y == 3
+    ds2 = ds.transform_first(lambda x: x * 2)
+    x2, y2 = ds2[3]
+    assert np.allclose(x2, X[3] * 2) and y2 == 3
+
+
+def test_samplers():
+    assert list(SequentialSampler(4)) == [0, 1, 2, 3]
+    assert sorted(RandomSampler(10)) == list(range(10))
+    bs = BatchSampler(SequentialSampler(10), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 3, 1]
+    assert len(bs) == 4
+    bs = BatchSampler(SequentialSampler(10), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3, 3]
+    bs = BatchSampler(SequentialSampler(10), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3, 3]
+    assert [len(b) for b in bs] == [3, 3, 3]  # rolled-over 1 + 10 = 11 -> 3
+
+
+def test_dataloader_basic():
+    X = np.random.rand(25, 4).astype(np.float32)
+    Y = np.arange(25).astype(np.int32)
+    dl = DataLoader(ArrayDataset(X, Y), batch_size=10)
+    batches = list(dl)
+    assert [b[0].shape[0] for b in batches] == [10, 10, 5]
+    # order preserved without shuffle
+    np.testing.assert_allclose(batches[0][1].asnumpy(), np.arange(10))
+    got = np.concatenate([b[1].asnumpy() for b in
+                          DataLoader(ArrayDataset(X, Y), batch_size=10,
+                                     shuffle=True)])
+    assert sorted(got.tolist()) == list(range(25))
+
+
+def test_dataloader_workers_and_crash():
+    X = np.random.rand(30, 4).astype(np.float32)
+    Y = np.arange(30).astype(np.int32)
+    dl = DataLoader(ArrayDataset(X, Y), batch_size=8, num_workers=2)
+    for _ in range(2):  # two epochs over the same pool
+        got = np.concatenate([b[1].asnumpy() for b in dl])
+        assert sorted(got.tolist()) == list(range(30))
+
+    def boom(x):
+        raise ValueError("intentional worker failure")
+
+    bad = DataLoader(ArrayDataset(X, Y).transform_first(boom),
+                     batch_size=8, num_workers=2)
+    with pytest.raises(RuntimeError, match="intentional worker failure"):
+        next(iter(bad))
+
+
+def test_record_file_dataset(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(7):
+        w.write_idx(i, b"payload-%d" % i)
+    w.close()
+    ds = RecordFileDataset(rec)
+    assert len(ds) == 7
+    assert ds[4] == b"payload-4"
+
+
+def test_image_record_dataset_training(tmp_path):
+    """End-to-end: synthetic images packed to .rec, read through
+    ImageRecordDataset + transforms + DataLoader workers, conv net
+    learns (VERDICT r1 item 4 'done' criterion)."""
+    rec = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    # class 0 = dark images, class 1 = bright images
+    for i in range(64):
+        label = i % 2
+        base = 40 if label == 0 else 200
+        img = rng.randint(base - 30, base + 30,
+                          size=(24, 24, 3)).astype(np.uint8)
+        packed = recordio.pack_img(
+            recordio.IRHeader(0, float(label), i, 0), img, quality=95)
+        w.write_idx(i, packed)
+    w.close()
+
+    tfm = transforms.Compose([transforms.RandomFlipLeftRight(),
+                              transforms.ToTensor()])
+    ds = ImageRecordDataset(rec).transform_first(tfm)
+    dl = DataLoader(ds, batch_size=16, shuffle=True, num_workers=2)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, activation="relu"),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Dense(2))
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(4):
+        for xb, yb in dl:
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+    correct = total = 0
+    for xb, yb in dl:
+        pred = net(xb).asnumpy().argmax(axis=1)
+        correct += (pred == yb.asnumpy()).sum()
+        total += len(pred)
+    assert correct / total > 0.9, "rec->DataLoader training failed (%.2f)" \
+        % (correct / total)
+
+
+def test_mnist_dataset(tmp_path):
+    """Synthetic idx-ubyte files exercise the real parser."""
+    import gzip
+    import struct
+
+    root = str(tmp_path)
+    images = np.random.randint(0, 255, size=(10, 28, 28),
+                               dtype=np.uint8)
+    labels = np.arange(10, dtype=np.uint8)
+    with gzip.open(os.path.join(root, "train-images-idx3-ubyte.gz"),
+                   "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, 10, 28, 28))
+        f.write(images.tobytes())
+    with gzip.open(os.path.join(root, "train-labels-idx1-ubyte.gz"),
+                   "wb") as f:
+        f.write(struct.pack(">II", 0x801, 10))
+        f.write(labels.tobytes())
+    ds = MNIST(root=root, train=True)
+    assert len(ds) == 10
+    img, label = ds[3]
+    assert img.shape == (28, 28, 1) and label == 3
+    np.testing.assert_array_equal(img[:, :, 0], images[3])
+
+
+def test_cifar10_dataset(tmp_path):
+    root = str(tmp_path)
+    rng = np.random.RandomState(1)
+    recs = []
+    labels = []
+    for i in range(8):
+        labels.append(i % 10)
+        img = rng.randint(0, 255, size=(3072,), dtype=np.uint8)
+        recs.append(np.concatenate([[labels[-1]], img]).astype(np.uint8))
+    blob = np.stack(recs).tobytes()
+    for name in ["data_batch_%d.bin" % i for i in range(1, 6)]:
+        with open(os.path.join(root, name), "wb") as f:
+            f.write(blob)
+    ds = CIFAR10(root=root, train=True)
+    assert len(ds) == 40
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3) and label == 0
+
+
+def test_transforms_shapes():
+    img = (np.random.rand(40, 30, 3) * 255).astype(np.uint8)
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 40, 30) and t.max() <= 1.0
+    n = transforms.Normalize([0.5] * 3, [0.25] * 3)(t)
+    assert n.shape == (3, 40, 30)
+    r = transforms.Resize(16)(img)
+    assert r.shape == (16, 16, 3)
+    rk = transforms.Resize(16, keep_ratio=True)(img)
+    assert min(rk.shape[:2]) == 16
+    c = transforms.CenterCrop(20)(img)
+    assert c.shape == (20, 20, 3)
+    rc = transforms.RandomResizedCrop(24)(img)
+    assert rc.shape == (24, 24, 3)
+    for t in (transforms.RandomBrightness(0.3),
+              transforms.RandomContrast(0.3),
+              transforms.RandomSaturation(0.3), transforms.RandomHue(0.1),
+              transforms.RandomColorJitter(0.2, 0.2, 0.2, 0.1),
+              transforms.RandomLighting(0.1)):
+        out = t(img)
+        assert out.shape == img.shape and out.dtype == np.uint8

@@ -318,3 +318,52 @@ def test_fused_rnn_initializer():
 
     init2 = _from_spec(spec)
     assert init2._num_hidden == H
+
+
+def test_fused_cell_get_next_state():
+    """Slice-indexing multi-output RNN symbols (r2 review finding)."""
+    data = mx.sym.Variable("data")
+    fcell = mx.rnn.FusedRNNCell(5, num_layers=2, mode="lstm",
+                                prefix="lstm_", get_next_state=True)
+    out, states = fcell.unroll(4, data, layout="NTC", merge_outputs=True)
+    assert len(states) == 2
+    grp = mx.sym.Group([out] + states)
+    ex = grp.simple_bind(ctx=mx.cpu(), data=(3, 4, 6))
+    outs = ex.forward()
+    assert outs[0].shape == (3, 4, 5)
+    assert outs[1].shape == (2, 3, 5)  # state h
+    assert outs[2].shape == (2, 3, 5)  # state c
+
+
+def test_residual_cell_valid_length_masking():
+    cell = gluon.rnn.ResidualCell(gluon.rnn.RNNCell(3))
+    cell.initialize()
+    x = mx.nd.random.normal(0, 1, shape=(2, 4, 3))
+    vl = mx.nd.array([2, 4])
+    out, _ = cell.unroll(4, x, layout="NTC", merge_outputs=True,
+                         valid_length=vl)
+    o = out.asnumpy()
+    assert np.allclose(o[0, 2:], 0), "padded residual steps must be zero"
+
+
+def test_lstm_state_clip_per_step():
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    T, N, I, H = 6, 2, 3, 4
+    psz = rnn_param_size(1, H, I, "lstm")
+    data = mx.nd.random.normal(0, 5, shape=(T, N, I))
+    params = mx.nd.random.normal(0, 2, shape=(psz,))
+    out = mx.nd.RNN(data, params, mx.nd.zeros((1, N, H)),
+                    mx.nd.zeros((1, N, H)), state_size=H, num_layers=1,
+                    mode="lstm", state_outputs=True,
+                    lstm_state_clip_min=-0.01, lstm_state_clip_max=0.01)
+    # if c is clipped per step, |h| <= sigmoid * tanh(0.01) ~ 0.01
+    assert np.abs(out[0].asnumpy()).max() <= 0.011
+
+
+def test_subclass_initializer_dumps_roundtrip():
+    from mxnet_tpu.initializer import MSRAPrelu, _from_spec
+
+    spec = MSRAPrelu().dumps()
+    init2 = _from_spec(spec)
+    assert type(init2).__name__ == "MSRAPrelu"
