@@ -21,6 +21,7 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from .base import MXNetError
+from .attribute import AttrScope
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import engine
 from . import random

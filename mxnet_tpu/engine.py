@@ -72,19 +72,19 @@ def wait_for_var(array):
 
 
 def wait_for_all():
-    """Engine::WaitForAll (include/mxnet/engine.h:233)."""
+    """Engine::WaitForAll (include/mxnet/engine.h:233).
+
+    Like the reference's threaded engine, asynchronous failures surface at
+    wait points (src/engine/threaded_engine.h:180 stores the exception on
+    the var and rethrows at WaitForVar/WaitForAll): any error raised by the
+    effects barrier or by a per-device sync propagates to the caller.
+    """
     import jax
 
-    try:
-        jax.effects_barrier()
-    except Exception:
-        pass
+    jax.effects_barrier()
     # Barrier on every live device by synchronizing a trivial transfer.
     for d in jax.devices():
-        try:
-            jax.device_put(0, d).block_until_ready()
-        except Exception:
-            pass
+        jax.device_put(0, d).block_until_ready()
 
 
 @contextlib.contextmanager

@@ -10,9 +10,16 @@ XLA executable, and backward into one vjp executable (built lazily on
 first backward). XLA buffer assignment replaces NNVM PlanMemory; there
 are no per-op engine pushes to bulk. A new input shape (bucketing)
 simply retraces — the per-signature executable cache is jax.jit's.
-`group2ctx` model-parallel placement is accepted for API parity; under
-SPMD the mesh sharding (mxnet_tpu.parallel) is the idiomatic
-equivalent, so placement attrs are advisory here.
+`group2ctx` model-parallel placement (reference AssignContext,
+src/executor/graph_executor.cc:907, with _CrossDeviceCopy inserted at
+group boundaries, src/operator/cross_device_copy.cc:31-68) is honored
+for real: when the bound symbol carries ``__ctx_group__`` attrs and a
+``group2ctx`` map is given, the graph is evaluated eagerly with each
+op's inputs transferred (``jax.device_put``) to its group's device —
+the transfer *is* the cross-device copy. Unknown groups and absent
+devices raise at bind time instead of being silently ignored. Under
+SPMD the mesh sharding (mxnet_tpu.parallel) remains the idiomatic
+high-performance equivalent; group placement is the parity path.
 """
 from __future__ import annotations
 
@@ -90,6 +97,34 @@ class Executor:
         self._vjp = None
         self._last_fwd = None
 
+        # -- group2ctx model-parallel placement --------------------------
+        self._group2ctx = dict(group2ctx or {})
+        used_groups = {n._attrs.get("__ctx_group__")
+                       for n in symbol._topo()
+                       if n._attrs.get("__ctx_group__") is not None}
+        self._node_device = {}
+        if used_groups and self._group2ctx:
+            from .context import Context as _Ctx
+
+            unknown = used_groups - set(self._group2ctx)
+            if unknown:
+                raise MXNetError(
+                    "bind: symbol uses ctx_group(s) %s with no entry in "
+                    "group2ctx %s" % (sorted(unknown),
+                                      sorted(self._group2ctx)))
+            group_dev = {}
+            for g, c in self._group2ctx.items():
+                c = c if isinstance(c, _Ctx) else _Ctx(c)
+                group_dev[g] = c.jax_device  # raises if device absent
+            default_dev = (_Ctx(ctx).jax_device if ctx is not None
+                           else _Ctx.default_ctx().jax_device)
+            for n in symbol._topo():
+                if n._op is None:
+                    continue
+                g = n._attrs.get("__ctx_group__")
+                self._node_device[id(n)] = (group_dev[g] if g is not None
+                                            else default_dev)
+
     # -- graph evaluation -----------------------------------------------------
 
     def _eval_graph(self, arg_map, aux_map, out_syms):
@@ -112,6 +147,13 @@ class Executor:
             op = _registry.get(op_name)
             in_vals = [value_of(i, i._out_index or 0) for i in node._inputs]
             in_vals = _registry.prep_inputs(op, in_vals)
+            dev = self._node_device.get(id(node))
+            if dev is not None:
+                # cross-device copy at group boundaries (reference
+                # _CrossDeviceCopy): inputs move to this op's device.
+                import jax as _jax
+
+                in_vals = [_jax.device_put(v, dev) for v in in_vals]
             attrs = node._clean_attrs()
             if op.train_aware:
                 attrs = dict(attrs, training=autograd.is_training())
@@ -164,7 +206,13 @@ class Executor:
 
         fn = self._fwd_cache.get(is_train)
         if fn is None:
-            fn = jax.jit(self._forward_fn(is_train))
+            fn = self._forward_fn(is_train)
+            if not self._node_device:
+                # One XLA executable for the whole graph. With group
+                # placement active the graph instead runs eagerly so each
+                # op executes on its group's device (a single executable
+                # cannot span explicitly placed devices without a mesh).
+                fn = jax.jit(fn)
             self._fwd_cache[is_train] = fn
         arg_vals = [a._data for a in self.arg_arrays]
         aux_vals = [a._data for a in self.aux_arrays]
@@ -210,7 +258,7 @@ class Executor:
                     grad_vals)
                 return pullback(head_grads)[0]
 
-            self._vjp = jax.jit(vjp_fn)
+            self._vjp = vjp_fn if self._node_device else jax.jit(vjp_fn)
 
         import jax.numpy as jnp
 

@@ -75,6 +75,17 @@ def _worker_initializer(dataset):
     # dataloader.py:worker_loop receives the dataset through the fork).
     global _worker_dataset
     _worker_dataset = dataset
+    # Enforce the "workers never touch the TPU client" contract (the
+    # reference quiesces its engine across fork, src/initialize.cc:52):
+    # a forked child that accidentally calls into jax must not try to
+    # grab the accelerator — pin any fresh backend resolution to cpu.
+    # Only in a real child process: with thread_pool=True this
+    # initializer runs inside the parent, whose env must stay untouched.
+    import multiprocessing as _mp
+    import os
+
+    if _mp.parent_process() is not None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 def _worker_fn(samples, batchify_fn):
@@ -94,20 +105,37 @@ def _as_numpy(batch):
 
 
 def _to_ndarray(batch, pin=False):
+    """Rebuild NDArrays from worker-produced numpy batches.
+
+    ``pin=True`` is the TPU analogue of the reference's pinned-memory
+    staging (cpu_pinned context): the host→HBM transfer for every array
+    in the batch is *started now* (async device_put onto the
+    accelerator), so it overlaps with the training step instead of
+    happening lazily at first use. With ``pin=False`` placement follows
+    the current context as usual.
+    """
     if isinstance(batch, np.ndarray):
-        return nd.array(batch)
+        return nd.array(batch, ctx=_accel_ctx()) if pin else nd.array(batch)
     if isinstance(batch, (list, tuple)):
-        return [_to_ndarray(b) for b in batch]
+        return [_to_ndarray(b, pin) for b in batch]
     return batch
+
+
+def _accel_ctx():
+    from ...context import Context, num_tpus
+
+    return Context("tpu", 0) if num_tpus() else None
 
 
 class _MultiWorkerIter:
     """Async iterator over a worker pool with bounded prefetch
     (reference dataloader.py:_MultiWorkerIter)."""
 
-    def __init__(self, pool, batchify_fn, batch_sampler, prefetch):
+    def __init__(self, pool, batchify_fn, batch_sampler, prefetch,
+                 pin_memory=False):
         self._pool = pool
         self._batchify_fn = batchify_fn
+        self._pin_memory = pin_memory
         self._iter = iter(batch_sampler)
         self._data_buffer = {}
         self._rcvd_idx = 0
@@ -135,7 +163,7 @@ class _MultiWorkerIter:
         batch = ret.get()
         if isinstance(batch, _WorkerError):
             batch = batch.reraise()
-        return _to_ndarray(batch)
+        return _to_ndarray(batch, self._pin_memory)
 
     def __iter__(self):
         return self
@@ -204,10 +232,12 @@ class DataLoader:
             def same_process_iter():
                 for batch in self._batch_sampler:
                     yield _to_ndarray(_as_numpy(self._batchify_fn(
-                        [self._dataset[idx] for idx in batch])))
+                        [self._dataset[idx] for idx in batch])),
+                        self._pin_memory)
             return same_process_iter()
         return _MultiWorkerIter(self._pool, self._batchify_fn,
-                                self._batch_sampler, self._prefetch)
+                                self._batch_sampler, self._prefetch,
+                                self._pin_memory)
 
     def __len__(self):
         return len(self._batch_sampler)

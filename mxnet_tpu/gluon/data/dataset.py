@@ -79,19 +79,28 @@ class _TransformFirstClosure:
 
 
 class ArrayDataset(Dataset):
-    """Zip of N indexables (reference dataset.py:74)."""
+    """Zip of N indexables (reference dataset.py:74).
+
+    Device-backed NDArrays are snapshot to host numpy at construction:
+    datasets feed fork-based DataLoader workers, which must never call
+    into the device runtime (dataloader.py contract), so the stored form
+    is host memory and placement happens per batch in the consumer.
+    """
 
     def __init__(self, *args):
-        assert len(args) > 0, "Needs at least 1 arrays"
+        if not args:
+            raise ValueError("ArrayDataset requires at least one array")
         self._length = len(args[0])
         self._data = []
         for i, data in enumerate(args):
-            assert len(data) == self._length, \
-                "All arrays must have the same length; array[0] has " \
-                "length %d while array[%d] has %d." % (
-                    self._length, i, len(data))
+            if len(data) != self._length:
+                raise ValueError(
+                    "ArrayDataset arrays disagree on length: [0] -> %d, "
+                    "[%d] -> %d" % (self._length, i, len(data)))
             if isinstance(data, (list, tuple)):
                 data = SimpleDataset(data)
+            elif hasattr(data, "asnumpy"):
+                data = data.asnumpy()
             self._data.append(data)
 
     def __getitem__(self, idx):

@@ -41,43 +41,45 @@ class RandomSampler(Sampler):
 
 
 class BatchSampler(Sampler):
-    """Groups a sampler into batches; last_batch in {'keep', 'discard',
-    'rollover'} (reference sampler.py:BatchSampler)."""
+    """Groups an index sampler into batches (reference
+    sampler.py:BatchSampler).
+
+    ``last_batch`` picks the policy for a short final batch: ``'keep'``
+    yields it as-is, ``'discard'`` drops it, ``'rollover'`` carries its
+    indices into the first batch of the next epoch.
+    """
+
+    _POLICIES = ("keep", "discard", "rollover")
 
     def __init__(self, sampler, batch_size, last_batch="keep"):
+        if last_batch not in self._POLICIES:
+            raise ValueError("invalid last_batch %r: choose from %s"
+                             % (last_batch, "/".join(self._POLICIES)))
         self._sampler = sampler
         self._batch_size = batch_size
         self._last_batch = last_batch
-        self._prev = []
+        self._carry = []  # indices rolled over from the previous epoch
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
-            if len(batch) == self._batch_size:
-                yield batch
-                batch = []
-        if batch:
-            if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "discard":
-                pass
-            elif self._last_batch == "rollover":
-                self._prev = batch
-            else:
-                raise ValueError(
-                    "last_batch must be one of 'keep', 'discard', or "
-                    "'rollover', but got %s" % self._last_batch)
+        pending = list(self._carry)
+        self._carry = []
+        for idx in self._sampler:
+            pending.append(idx)
+            if len(pending) >= self._batch_size:
+                yield pending
+                pending = []
+        if not pending:
+            return
+        if self._last_batch == "keep":
+            yield pending
+        elif self._last_batch == "rollover":
+            self._carry = pending
+        # 'discard': short tail is dropped
 
     def __len__(self):
+        n = len(self._sampler)
         if self._last_batch == "keep":
-            return (len(self._sampler) + self._batch_size - 1) // \
-                self._batch_size
-        if self._last_batch == "discard":
-            return len(self._sampler) // self._batch_size
+            return -(-n // self._batch_size)  # ceil
         if self._last_batch == "rollover":
-            return (len(self._prev) + len(self._sampler)) // \
-                self._batch_size
-        raise ValueError(
-            "last_batch must be one of 'keep', 'discard', or 'rollover', "
-            "but got %s" % self._last_batch)
+            n += len(self._carry)
+        return n // self._batch_size

@@ -487,7 +487,12 @@ def _jsonify_attrs(attrs):
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs):
     """Create a symbolic variable (reference symbol.py:var)."""
+    from .attribute import current_attrs
+
     s = Symbol(None, name=name)
+    scoped = current_attrs()
+    if scoped:
+        s._attrs.update({"__%s__" % k: v for k, v in scoped.items()})
     if attr:
         s._attrs.update({"__%s__" % k: v for k, v in attr.items()})
     if shape is not None:
@@ -638,6 +643,11 @@ def _make_symbol_op(op_name):
         extra = [v for k, v in inputs.items() if k not in sig_params]
         node_attrs = dict(attrs)
         node_attrs["_op_name"] = op_name
+        from .attribute import current_attrs
+
+        scoped = current_attrs()
+        if scoped:
+            node_attrs.update({"__%s__" % k: v for k, v in scoped.items()})
         if attr:
             node_attrs.update({"__%s__" % k: v for k, v in attr.items()})
         rule = _NUM_OUTPUT_RULES.get(op_name)
