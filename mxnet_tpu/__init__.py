@@ -81,3 +81,14 @@ def __getattr__(attr):
 
 def waitall():
     ndarray.waitall()
+
+
+# A process launched with DMLC_ROLE=server/scheduler runs the blocking
+# parameter-server loop here and never returns to the user script —
+# mirroring the reference's python/mxnet/kvstore_server.py bootstrap.
+import os as _os
+
+if _os.environ.get("DMLC_ROLE", "").lower() in ("server", "scheduler"):
+    from .kvstore_server import _init_kvstore_server_module
+
+    _init_kvstore_server_module()

@@ -67,17 +67,48 @@ class Trainer:
 
     def _init_kvstore(self):
         """Create the kvstore lazily on first step (reference:
-        trainer.py:_init_kvstore). Needed only for multi-context."""
+        trainer.py:_init_kvstore). Needed for multi-context and for all
+        ``dist_*`` stores (even single-context: the sync happens across
+        worker processes, not local devices)."""
         contexts = self._check_contexts()
-        if len(contexts) > 1 and self._kvstore_type:
+        name = (self._kvstore_type.type
+                if hasattr(self._kvstore_type, "type")
+                else str(self._kvstore_type or ""))
+        dist = "dist" in name
+        if (len(contexts) > 1 or dist) and self._kvstore_type:
             from .. import kvstore as kvs
 
-            self._kvstore = kvs.create(self._kvstore_type
-                                       if isinstance(self._kvstore_type, str)
-                                       else "device")
+            self._kvstore = (self._kvstore_type
+                             if isinstance(self._kvstore_type, kvs.KVStore)
+                             else kvs.create(name))
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
+            # dist defaults to optimizer-on-server (reference trainer.py:
+            # update_on_kvstore defaults True for dist); local stores
+            # keep the local updater, which matches the reference's
+            # multi-device default here because our local updater already
+            # applies once-then-broadcast.
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = dist
+            if dist and "async" in name and not self._update_on_kvstore:
+                # Async pushes apply server-side immediately; without the
+                # optimizer there the server would assign raw gradients
+                # over the weights (reference raises the same way).
+                raise ValueError(
+                    "Please set update_on_kvstore=True for dist_async")
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
                     self._kvstore.init(i, p.data())
+        else:
+            if self._update_on_kvstore:
+                raise ValueError(
+                    "update_on_kvstore=True requires a kvstore (multi-"
+                    "context or dist_*); this trainer has %d context(s) "
+                    "and kvstore=%r" % (len(contexts), self._kvstore_type))
+            self._update_on_kvstore = False
         self._kv_initialized = True
 
     @property
@@ -90,15 +121,33 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce_grads + update (reference: trainer.py:step)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if not self._kv_initialized:
+            # Init after rescale_grad is final: dist stores pickle the
+            # optimizer to the servers once (reference sends optstr at
+            # kvstore init with the current rescale baked in).
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            # Optimizer-on-server: push ALL gradients first, then pull all
+            # weights (reference _update_params_on_kvstore ordering) — an
+            # interleaved per-key push/pull would turn every key into a
+            # cluster-wide sync point, since sync servers park the pull
+            # until all workers pushed that key.
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.push(i, p.list_grad())
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.pull(i, out=p.list_data())
+            return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
             self._init_kvstore()
+        assert not self._update_on_kvstore, \
+            "allreduce_grads is not supported with update_on_kvstore"
         self._allreduce_grads()
 
     def _allreduce_grads(self):
@@ -113,6 +162,8 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
+        assert not self._update_on_kvstore, \
+            "update() is not supported with update_on_kvstore"
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 

@@ -1,0 +1,303 @@
+"""KVStoreDist — worker side of the multi-process ``dist_*`` kvstores.
+
+Reference: src/kvstore/kvstore_dist.h:44-450 (KVStoreDist worker:
+EncodeDefaultKey big-array sharding across servers, PushImpl local
+comm_->Reduce then ZPush, PullImpl ZPull then broadcast, PullRowSparse of
+only the requested rows :209 region, compressed push path :334-366) and
+python/mxnet/kvstore.py (rank/num_workers, set_optimizer pickling the
+optimizer to servers, _barrier).
+
+TPU-native split of labor: the *intra-host* reduction of per-device
+gradients is XLA arithmetic riding ICI (inherited from KVStoreLocal._merge
+— on `dist_device_sync` the merge stays on device exactly like the
+reference's CommDevice), and only the already-reduced host-side value
+crosses the DCN to the parameter servers. On TPU pods the blessed
+scaling path is SPMD collectives over a global mesh
+(`mxnet_tpu.parallel.TrainStep` — one all-reduce fused into the step);
+this parameter-server mode exists for full API parity with the
+reference's `kvstore='dist_sync'` training scripts, and its transport is
+host TCP (DCN-equivalent), never ICI.
+
+Sync semantics preserved exactly (see kvstore_server.py): `dist_sync`
+aggregates all workers' pushes per key before one optimizer application
+on the server; `dist_async` updates per push with no barrier.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import zlib
+
+import numpy as np
+
+from .context import cpu
+from .kvstore import KVStoreLocal, _key_list, _val_list
+from .kvstore_server import _client
+from .ndarray import sparse as _sparse
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStoreDist"]
+
+
+def _server_of(key, num_servers):
+    """Stable key→server assignment (reference EncodeDefaultKey hashes key
+    ids across server ranges; crc32 is seed-independent across processes,
+    unlike Python's hash)."""
+    return zlib.crc32(repr(key).encode()) % num_servers
+
+
+class KVStoreDist(KVStoreLocal):
+    """Multi-process key-value store over parameter servers."""
+
+    def __init__(self, name="dist_sync"):
+        name = name.lower()
+        assert name in ("dist", "dist_sync", "dist_device_sync", "dist_async")
+        super().__init__(device_mode=(name == "dist_device_sync"))
+        self._name = name
+        self._sync = name != "dist_async"
+        self._bigarray_bound = int(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000"))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+        self._meta = {}             # key -> (shape, dtype)
+        self._compression = None
+        self._closed = False
+
+        sched_addr = (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                      int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")))
+        self._sched = _client(sched_addr)
+        self._sched.send(("register", "worker", None))
+        reply = self._sched.recv()
+        assert reply[0] == "registered"
+        self._rank = reply[1]
+        book = self._sched.recv()
+        assert book[0] == "addressbook"
+        self._servers = [_client(addr) for addr in book[1]]
+        for conn in self._servers:
+            conn.send(("hello", self._sync))
+        atexit.register(self.close)
+
+    # -- identification -------------------------------------------------------
+
+    @property
+    def type(self):
+        return self._name
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    # -- transport helpers ----------------------------------------------------
+
+    def _call(self, server_idx, msg):
+        conn = self._servers[server_idx]
+        conn.send(msg)
+        reply = conn.recv()
+        if reply[0] == "error":
+            raise RuntimeError("kvstore server %d: %s" % (server_idx, reply[1]))
+        return reply[1] if len(reply) > 1 else None
+
+    def _shards(self, key, shape, stype="default"):
+        """Yield (server_idx, subkey, flat_slice) shards for a key.
+
+        Dense arrays of ``size >= MXNET_KVSTORE_BIGARRAY_BOUND`` are
+        sliced contiguously across *all* servers (reference kvstore_dist.h
+        EncodeDefaultKey); smaller keys live whole on one hashed server.
+        row_sparse keys are never sliced regardless of size — the server
+        needs whole rows for scatter-add and row_sparse_pull (the
+        reference shards those by row range; whole-key placement keeps
+        the same wire semantics on one server).
+        """
+        size = int(np.prod(shape)) if shape else 1
+        if (stype == "row_sparse" or size < self._bigarray_bound
+                or self._num_servers == 1):
+            return [(_server_of(key, self._num_servers), key, None)]
+        bounds = np.linspace(0, size, self._num_servers + 1).astype(np.int64)
+        return [(i, (key, i), slice(int(bounds[i]), int(bounds[i + 1])))
+                for i in range(self._num_servers)
+                if bounds[i + 1] > bounds[i]]
+
+    # -- core API -------------------------------------------------------------
+
+    def init(self, key, value):
+        """Rank 0 seeds the servers; everyone records shape metadata and a
+        barrier makes the value visible before any worker proceeds
+        (reference: only rank 0's init reaches servers, kvstore.py:init)."""
+        keys, single = _key_list(key)
+        vals = _val_list(value, len(keys), single)
+        for k, vlist in zip(keys, vals):
+            v = vlist[0]
+            if isinstance(v, _sparse.RowSparseNDArray):
+                dense = v.todense().asnumpy()
+                self._meta[k] = (dense.shape, dense.dtype, "row_sparse")
+                if self._rank == 0:
+                    sidx, subkey, _ = self._shards(k, dense.shape,
+                                                   "row_sparse")[0]
+                    self._call(sidx, ("init", subkey, dense))
+                continue
+            arr = v.asnumpy()
+            self._meta[k] = (arr.shape, arr.dtype, "default")
+            if self._rank == 0:
+                flat = arr.reshape(-1)
+                for sidx, subkey, sl in self._shards(k, arr.shape):
+                    part = arr if sl is None else flat[sl]
+                    self._call(sidx, ("init", subkey, part))
+        self._barrier()
+
+    def push(self, key, value, priority=0):
+        keys, single = _key_list(key)
+        vals = _val_list(value, len(keys), single)
+        for k, vlist in zip(keys, vals):
+            assert k in self._meta, "key %r was not initialized" % (k,)
+            if isinstance(vlist[0], _sparse.RowSparseNDArray):
+                self._push_row_sparse(k, vlist)
+                continue
+            # Local device reduce first (XLA over ICI; host copy only for
+            # the single merged value) — reference comm_->Reduce.
+            merged = self._merge(vlist)
+            arr = merged.asnumpy()
+            flat = arr.reshape(-1)
+            for sidx, subkey, sl in self._shards(k, arr.shape,
+                                                 self._meta[k][2]):
+                part = arr if sl is None else flat[sl]
+                if self._compression is not None:
+                    packed, meta = self._compression.compress(subkey, part)
+                    self._call(sidx, ("push_compressed", subkey, packed, meta))
+                else:
+                    self._call(sidx, ("push", subkey, part))
+
+    def _push_row_sparse(self, k, vlist):
+        """Merge row_sparse device grads by concatenating (indices, values)
+        — the server scatter-adds, so duplicates sum, matching the
+        reference's row_sparse reduce."""
+        idx = np.concatenate([v.indices.asnumpy().astype(np.int64)
+                              for v in vlist])
+        val = np.concatenate([v.data.asnumpy() for v in vlist])
+        sidx, subkey, _ = self._shards(k, self._meta[k][0], "row_sparse")[0]
+        self._call(sidx, ("push_rsp", subkey, idx, val))
+
+    def _fetch(self, k):
+        shape, dtype, stype = self._meta[k]
+        shards = self._shards(k, shape, stype)
+        if len(shards) == 1 and shards[0][2] is None:
+            return np.asarray(self._call(shards[0][0],
+                                         ("pull", shards[0][1]))).reshape(shape)
+        out = np.empty(int(np.prod(shape)), dtype=dtype)
+        for sidx, subkey, sl in shards:
+            out[sl] = self._call(sidx, ("pull", subkey))
+        return out.reshape(shape)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None, "pull requires out="
+        keys, single = _key_list(key)
+        outs = _val_list(out, len(keys), single)
+        for k, olist in zip(keys, outs):
+            value = self._fetch(k)
+            for o in olist:
+                o[:] = value
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows across the DCN (reference
+        PullRowSparse, kvstore.h:209 — the bandwidth saver for big
+        embeddings; no densified transfer)."""
+        assert out is not None and row_ids is not None
+        keys, single = _key_list(key)
+        outs = _val_list(out, len(keys), single)
+        rows = [[row_ids]] * len(keys) if isinstance(row_ids, NDArray) else \
+            _val_list(row_ids, len(keys), single)
+        for k, olist, rlist in zip(keys, outs, rows):
+            shape, _, stype = self._meta[k]
+            sidx, subkey, _ = self._shards(k, shape, stype)[0]
+            for o, r in zip(olist, rlist * len(olist)
+                            if len(rlist) == 1 else rlist):
+                r_np = r.asnumpy().astype(np.int64)
+                vals = np.asarray(self._call(sidx, ("pull_rows", subkey, r_np)))
+                if isinstance(o, _sparse.RowSparseNDArray):
+                    from .ndarray.ndarray import array as _nd_array
+
+                    o._data = _nd_array(vals, ctx=o.context)._data
+                    o._indices = _nd_array(r_np, ctx=o.context, dtype="int64")
+                elif o.shape == shape:
+                    # Full-shape dense out: only the pulled rows are
+                    # refreshed; untouched rows keep their values.
+                    o[r_np] = vals.astype(o.dtype, copy=False)
+                else:
+                    o[:] = vals
+
+    # -- optimizer / compression ----------------------------------------------
+
+    def set_optimizer(self, optimizer):
+        """Pickle the optimizer to every server (reference kvstore.py:
+        set_optimizer → _send_command_to_servers(0, optstr) from rank 0).
+        `param_dict` holds live Parameter objects and does not cross the
+        wire — per-param lr/wd multipliers don't survive serialization,
+        the same caveat the reference's optstr path has."""
+        self._optimizer = optimizer
+        if self._rank == 0:
+            param_dict = optimizer.param_dict
+            optimizer.param_dict = {}
+            try:
+                blob = pickle.dumps(optimizer)
+            finally:
+                optimizer.param_dict = param_dict
+            for sidx in range(len(self._servers)):
+                self._call(sidx, ("set_optimizer", blob))
+        self._barrier()
+
+    def set_gradient_compression(self, compression_params):
+        from .gradient_compression import GradientCompression
+
+        self._compression_params = dict(compression_params)
+        self._compression = GradientCompression(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        """Gather per-server updater states (the optimizer state lives on
+        the servers in dist mode — reference kvstore.py notes exactly
+        this for update_on_kvstore)."""
+        blobs = [self._call(s, ("get_states",))
+                 for s in range(len(self._servers))]
+        with open(fname, "wb") as f:
+            pickle.dump(blobs, f)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            blobs = pickle.load(f)
+        if self._rank == 0:
+            for sidx, blob in enumerate(blobs):
+                if blob:
+                    self._call(sidx, ("set_states", blob))
+        self._barrier()
+
+    # -- coordination ---------------------------------------------------------
+
+    def _barrier(self):
+        """Block until all workers arrive (reference kvstore.py:_barrier →
+        MXKVStoreBarrier over the ps-lite scheduler)."""
+        self._sched.send(("barrier",))
+        reply = self._sched.recv()
+        if reply[0] != "barrier_done":
+            raise RuntimeError(
+                "kvstore barrier failed (a worker died or timed out): %r"
+                % (reply,))
+
+    barrier = _barrier
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._sched.send(("finalize",))
+            self._sched.close()
+        except OSError:
+            pass
+        for conn in self._servers:
+            try:
+                conn.close()
+            except OSError:
+                pass

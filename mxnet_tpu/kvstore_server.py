@@ -1,0 +1,421 @@
+"""KVStore server & scheduler roles — the parameter-server side of
+``dist_*`` kvstores.
+
+Reference: src/kvstore/kvstore_dist_server.h:155-400 (KVStoreDistServer:
+sync-mode aggregation `DataHandleDefault`, optimizer-on-server
+`ApplyUpdates` :325-348, deferred pull responses until the sync round's
+update lands, row_sparse handlers, command channel for set_optimizer),
+python/mxnet/kvstore_server.py (`_init_kvstore_server_module` — a process
+whose ``DMLC_ROLE`` is ``server``/``scheduler`` runs the blocking server
+loop at import and never returns to user code), and ps-lite's scheduler
+rendezvous (Postoffice/Van: node registration, address book broadcast,
+barriers).
+
+Execution model mirrors the reference exactly: ps-lite receives requests
+on I/O threads but *executes every handler on the server's single
+executor thread* (kvstore_dist_server.h:188 `exec_`), with pull requests
+that arrive mid sync-round parked and answered after `ApplyUpdates`.
+Here: one reader thread per worker connection enqueues raw messages; the
+main thread — the only one that runs optimizer math — drains the queue.
+This single-consumer design is also what makes running inside ``import
+mxnet_tpu`` safe: the main thread still holds the package import lock,
+and it is the only thread that triggers lazy imports (module locks are
+reentrant for their owner; any *other* thread importing from the package
+would deadlock against the never-finishing import).
+
+TPU-native design: parameter-server traffic is *host-side DCN traffic by
+construction* — gradients have already been reduced across local devices
+by XLA over ICI before a worker pushes (kvstore_dist.py), so the server
+never talks to an accelerator; server processes pin themselves to the CPU
+platform and apply the optimizer with the same jitted update ops workers
+use, on host buffers. Transport is length-prefixed pickled messages over
+TCP (`multiprocessing.connection`) replacing ps-lite's ZMQ Van; the
+scheduler is a pure rendezvous + barrier service exactly like ps-lite's
+scheduler role.
+
+Roles and env contract (set by tools/launch.py, mirroring the reference's
+DMLC launcher variables):
+
+- ``DMLC_ROLE``: ``worker`` / ``server`` / ``scheduler``
+- ``DMLC_PS_ROOT_URI`` / ``DMLC_PS_ROOT_PORT``: scheduler address
+- ``DMLC_NUM_WORKER`` / ``DMLC_NUM_SERVER``: group sizes
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import sys
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["KVStoreServer", "Scheduler", "_init_kvstore_server_module"]
+
+_AUTHKEY = os.environ.get("MXNET_TPU_PS_AUTHKEY", "mxnet_tpu_kvstore").encode()
+_WAIT_TIMEOUT = float(os.environ.get("MXNET_TPU_PS_TIMEOUT", "300"))
+_DEBUG = bool(int(os.environ.get("MXNET_KVSTORE_DEBUG", "0")))
+
+
+def _dbg(*args):
+    """Verbose PS tracing (reference MXNET_ENGINE_INFO-style env knob)."""
+    if _DEBUG:
+        print("[kvstore %s/%d]" % (os.environ.get("DMLC_ROLE", "?"),
+                                   os.getpid()), *args,
+              file=sys.stderr, flush=True)
+
+
+def _listener(host, port=0):
+    from multiprocessing.connection import Listener
+
+    # backlog must cover the whole node group connecting at once (ps-lite's
+    # Van listens with a deep backlog for the same reason).
+    return Listener((host, port), family="AF_INET", backlog=128,
+                    authkey=_AUTHKEY)
+
+
+def _client(addr, retry_for=30.0):
+    """Connect with retry — roles race at startup (workers/servers may dial
+    the scheduler before its socket is up, like ps-lite's connect loop)."""
+    from multiprocessing.connection import Client
+
+    deadline = time.time() + retry_for
+    while True:
+        try:
+            return Client(tuple(addr), family="AF_INET", authkey=_AUTHKEY)
+        except (ConnectionRefusedError, OSError):
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+class Scheduler:
+    """Rendezvous + barrier service (ps-lite scheduler role).
+
+    Every node (server or worker) connects once and keeps the connection:
+    servers receive the final ``shutdown`` over it; workers use it for
+    ``barrier`` rounds. Ranks are assigned in registration order (the
+    reference's ps-lite assigns node ids on Van registration the same
+    way). Scheduler threads touch only stdlib state — no package imports.
+    """
+
+    def __init__(self, num_workers, num_servers, host=None, port=None):
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        host = host or os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(port if port is not None
+                   else os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._listener = _listener(host, port)
+        self._lock = threading.Lock()
+        self._servers = {}          # server_id -> (host, port)
+        self._next_worker = 0
+        self._next_server = 0
+        self._all_registered = threading.Event()
+        self._barrier = threading.Barrier(num_workers) if num_workers else None
+        self._finalized = 0
+        self._done = threading.Event()
+
+    def run(self):
+        """Serve until every worker has finalized, then shut servers down."""
+        total = self.num_workers + self.num_servers
+        for _ in range(total):
+            conn = self._listener.accept()
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        self._done.wait(_WAIT_TIMEOUT * 4)
+        self._listener.close()
+
+    def _serve_conn(self, conn):
+        msg = conn.recv()
+        assert msg[0] == "register", msg
+        role = msg[1]
+        with self._lock:
+            if role == "server":
+                node_id = self._next_server
+                self._next_server += 1
+                self._servers[node_id] = msg[2]
+            else:
+                node_id = self._next_worker
+                self._next_worker += 1
+            if (self._next_worker == self.num_workers
+                    and self._next_server == self.num_servers):
+                self._all_registered.set()
+        conn.send(("registered", node_id))
+        if not self._all_registered.wait(_WAIT_TIMEOUT):
+            conn.close()
+            raise RuntimeError("scheduler: rendezvous timed out")
+        book = [self._servers[i] for i in sorted(self._servers)]
+        conn.send(("addressbook", book))
+        if role == "server":
+            # Server connections are write-only from here; hold until all
+            # workers finalize, then deliver shutdown.
+            self._done.wait(_WAIT_TIMEOUT * 4)
+            try:
+                conn.send(("shutdown",))
+                conn.close()
+            except OSError:
+                pass
+            return
+        # Worker command loop.
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                msg = ("finalize",)
+            if msg[0] == "barrier":
+                try:
+                    self._barrier.wait(_WAIT_TIMEOUT)
+                    conn.send(("barrier_done",))
+                except threading.BrokenBarrierError:
+                    # A worker died or timed out: fail the barrier loudly
+                    # on every survivor instead of hanging the cluster.
+                    try:
+                        conn.send(("barrier_failed",))
+                    except OSError:
+                        pass
+            elif msg[0] == "finalize":
+                with self._lock:
+                    self._finalized += 1
+                    if self._finalized == self.num_workers:
+                        self._done.set()
+                    elif self._barrier is not None:
+                        # This worker is gone; any in-flight or future
+                        # barrier can never complete — break it so peers
+                        # get barrier_failed, not a silent hang.
+                        self._barrier.abort()
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _KeyState:
+    __slots__ = ("stored", "accum", "count", "pending_pulls")
+
+    def __init__(self, value):
+        self.stored = value                     # np.ndarray
+        self.accum = None
+        self.count = 0
+        self.pending_pulls = []                 # [(conn, rows or None)]
+
+
+class KVStoreServer:
+    """One key-sharded parameter server (reference KVStoreDistServer).
+
+    Sync mode (``dist_sync``/``dist_device_sync``): pushes for a key
+    accumulate until all ``num_workers`` have contributed, then the
+    updater (optimizer, if one was sent via ``set_optimizer``) is applied
+    once to the aggregate — pulls issued mid-round are parked and
+    answered after the update, which is how the reference defers pull
+    responses until `ApplyUpdates` (kvstore_dist_server.h:325-348). Async
+    mode (``dist_async``): the updater runs on every push immediately, no
+    barrier (kvstore_dist_server.h:348 region).
+    """
+
+    def __init__(self, scheduler_addr=None, num_workers=None, host=None):
+        self.scheduler_addr = scheduler_addr or (
+            os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")))
+        self.num_workers = int(num_workers if num_workers is not None
+                               else os.environ.get("DMLC_NUM_WORKER", "1"))
+        self.host = host or os.environ.get("DMLC_NODE_HOST", "127.0.0.1")
+        self._keys = {}
+        self._updater = None
+        self._sync_mode = True
+        self._queue = queue.Queue()
+        self.server_id = None
+
+    # -- update application (executor thread only) ----------------------------
+
+    def _apply(self, key, state, grad_np):
+        """Run the optimizer on ``stored`` (reference ApplyUpdates)."""
+        if self._updater is None:
+            # Default "updater" is assignment of the merged value
+            # (kvstore_dist_server.h: CopyFromTo(merged, &stored)).
+            state.stored = grad_np.astype(state.stored.dtype, copy=False)
+            return
+        from . import ndarray as nd
+
+        stored = nd.array(state.stored)
+        grad = nd.array(grad_np.astype(state.stored.dtype, copy=False))
+        self._updater(key, grad, stored)
+        state.stored = stored.asnumpy()
+
+    def _grad_from_msg(self, msg, state):
+        from .gradient_compression import GradientCompression
+
+        if msg[0] == "push":
+            return np.asarray(msg[2], dtype=np.float32)
+        if msg[0] == "push_compressed":
+            return GradientCompression.decompress(msg[2], msg[3])
+        # push_rsp: (cmd, key, indices, values) — scatter rows into a dense
+        # gradient of the stored shape (duplicates sum, like the
+        # reference's row_sparse merge on server).
+        _, _, indices, values = msg
+        grad = np.zeros(state.stored.shape, dtype=np.float32)
+        np.add.at(grad, np.asarray(indices, dtype=np.int64),
+                  np.asarray(values, dtype=np.float32))
+        return grad
+
+    @staticmethod
+    def _send(conn, msg):
+        try:
+            conn.send(msg)
+        except OSError:
+            pass
+
+    def _answer_pull(self, conn, state, rows):
+        value = state.stored if rows is None else state.stored[rows]
+        self._send(conn, ("val", value))
+
+    def _handle(self, conn, msg):
+        """Execute one request — runs exclusively on the executor thread
+        (reference: handlers run on the server's `exec_`)."""
+        cmd = msg[0]
+        _dbg("exec", cmd, msg[1] if len(msg) > 1 and cmd != "set_optimizer"
+             else "")
+        if cmd == "hello":
+            self._sync_mode = bool(msg[1])
+        elif cmd == "init":
+            self._keys[msg[1]] = _KeyState(np.asarray(msg[2]))
+            self._send(conn, ("ok",))
+        elif cmd in ("push", "push_compressed", "push_rsp"):
+            key = msg[1]
+            state = self._keys.get(key)
+            if state is None:
+                self._send(conn, ("error", "key %r not initialized" % (key,)))
+                return
+            grad = self._grad_from_msg(msg, state)
+            if not self._sync_mode:
+                self._apply(key, state, grad)
+                self._send(conn, ("ok",))
+                return
+            if state.accum is None:
+                state.accum = np.zeros(state.stored.shape, dtype=np.float32)
+            state.accum += grad
+            state.count += 1
+            if state.count == self.num_workers:
+                self._apply(key, state, state.accum)
+                state.accum = None
+                state.count = 0
+                for (pconn, prows) in state.pending_pulls:
+                    self._answer_pull(pconn, state, prows)
+                state.pending_pulls = []
+            self._send(conn, ("ok",))
+        elif cmd in ("pull", "pull_rows"):
+            key = msg[1]
+            state = self._keys.get(key)
+            if state is None:
+                self._send(conn, ("error", "key %r not initialized" % (key,)))
+                return
+            rows = np.asarray(msg[2]) if cmd == "pull_rows" else None
+            if self._sync_mode and state.count != 0:
+                # Mid sync-round: park until ApplyUpdates flushes us.
+                state.pending_pulls.append((conn, rows))
+            else:
+                self._answer_pull(conn, state, rows)
+        elif cmd == "set_optimizer":
+            from . import optimizer as opt
+
+            self._updater = opt.get_updater(pickle.loads(msg[1]))
+            self._send(conn, ("ok",))
+        elif cmd == "get_states":
+            blob = (self._updater.get_states(dump_optimizer=False)
+                    if self._updater else b"")
+            self._send(conn, ("val", blob))
+        elif cmd == "set_states":
+            if self._updater is not None:
+                self._updater.set_states(msg[1])
+            self._send(conn, ("ok",))
+        else:
+            self._send(conn, ("error", "unknown command %r" % (cmd,)))
+
+    # -- I/O threads: enqueue only, never import ------------------------------
+
+    def _reader(self, conn):
+        try:
+            while True:
+                msg = conn.recv()
+                self._queue.put((conn, msg))
+        except (EOFError, OSError):
+            return
+
+    def run(self):
+        """Register with the scheduler, then execute requests on this
+        thread until the scheduler says shutdown."""
+        listener = _listener(self.host, 0)
+        addr = listener.address
+        sched = _client(self.scheduler_addr)
+        sched.send(("register", "server", (addr[0], addr[1])))
+        reply = sched.recv()
+        assert reply[0] == "registered"
+        self.server_id = reply[1]
+        book = sched.recv()
+        assert book[0] == "addressbook"
+
+        def accept_loop():
+            while True:
+                try:
+                    conn = listener.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._reader, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+        threading.Thread(target=self._reader, args=(sched,),
+                         daemon=True).start()
+        while True:
+            conn, msg = self._queue.get()
+            if msg[0] == "shutdown":
+                break
+            try:
+                self._handle(conn, msg)
+            except Exception as exc:  # surface handler errors to the worker
+                _dbg("handler error:", exc)
+                self._send(conn, ("error", "%s: %s" % (type(exc).__name__,
+                                                       exc)))
+        listener.close()
+
+
+# ---------------------------------------------------------------------------
+# role bootstrap
+# ---------------------------------------------------------------------------
+
+def _init_kvstore_server_module():
+    """Run the blocking server/scheduler loop when this process's role says
+    so, then exit — mirroring the reference where ``import mxnet`` in a
+    ``DMLC_ROLE=server`` process never returns to the user script
+    (python/mxnet/kvstore_server.py:_init_kvstore_server_module).
+
+    Server/scheduler processes never touch the TPU: the JAX platform is
+    pinned to cpu before anything initializes a backend.
+    """
+    role = os.environ.get("DMLC_ROLE", "").lower()
+    if role not in ("server", "scheduler"):
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        # The env var alone can be overridden by site hooks; pin the
+        # platform through the config API before any backend initializes.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    if role == "scheduler":
+        Scheduler(int(os.environ["DMLC_NUM_WORKER"]),
+                  int(os.environ["DMLC_NUM_SERVER"])).run()
+    else:
+        KVStoreServer().run()
+    sys.exit(0)

@@ -461,6 +461,7 @@ class LBSGD(SGD):
             self.lr = lr_save
 
 
+@register
 class Test(Optimizer):
     def create_state(self, index, weight):
         return nd.zeros(weight.shape, ctx=weight.context)

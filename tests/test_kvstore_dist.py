@@ -1,0 +1,79 @@
+"""Distributed kvstore: real multi-process parameter-server traffic on one
+host (the reference's tests/nightly/dist_sync_kvstore.py pattern via
+tools/launch.py --launcher local).
+
+Each case spawns scheduler + 2 servers + 2 workers; workers run the
+numerical equality checks in tests/dist_prog.py and their exit codes are
+asserted here. MXNET_KVSTORE_BIGARRAY_BOUND is lowered so the big key
+exercises cross-server sharding without megabyte payloads.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from launch import launch_local  # noqa: E402
+
+_PROG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "dist_prog.py")
+
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "MXNET_KVSTORE_BIGARRAY_BOUND": "4000",
+    # Workers need only a couple of virtual devices; keep spawn cheap.
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+}
+
+
+def _run(kv_type, num_workers=2, num_servers=2, mode="kvstore"):
+    codes = launch_local(
+        num_workers, num_servers,
+        [sys.executable, _PROG, "--kv-type", kv_type, "--mode", mode],
+        env_extra=_ENV, timeout=300)
+    assert codes == [0] * num_workers, \
+        "worker exit codes for %s: %s" % (kv_type, codes)
+
+
+def test_dist_sync_kvstore():
+    _run("dist_sync")
+
+
+def test_dist_device_sync_kvstore():
+    _run("dist_device_sync")
+
+
+def test_dist_async_kvstore():
+    _run("dist_async")
+
+
+def test_dist_sync_training():
+    """Gluon Trainer end-to-end over dist_sync: optimizer-on-server,
+    per-worker shards, identical weights across workers."""
+    _run("dist_sync", mode="train")
+
+
+def test_two_bit_compression_codec():
+    """Codec unit test (reference tests/nightly/test_kvstore.py
+    compute_expected_2bit_quantization)."""
+    from mxnet_tpu.gradient_compression import GradientCompression
+
+    gc = GradientCompression({"type": "2bit", "threshold": 0.5})
+    grad = np.array([[0.7, -0.9, 0.1], [-0.2, 0.55, -3.0]], dtype=np.float32)
+    packed, meta = gc.compress("k", grad)
+    # 4x compression on the wire (2 bits/elem, byte-packed).
+    assert len(packed) == (grad.size + 3) // 4
+    dec = GradientCompression.decompress(packed, meta)
+    expected = np.where(grad >= 0.5, 0.5, np.where(grad <= -0.5, -0.5, 0.0))
+    np.testing.assert_allclose(dec, expected)
+    # Error feedback invariant: residual == accumulated-input minus
+    # accumulated-output after every round, so nothing is ever lost.
+    np.testing.assert_allclose(gc._residual["k"], grad - dec, atol=1e-6)
+    packed2, meta2 = gc.compress("k", grad)
+    dec2 = GradientCompression.decompress(packed2, meta2)
+    np.testing.assert_allclose(gc._residual["k"], 2 * grad - dec - dec2,
+                               atol=1e-6)
+    # A saturated element (|g| >> t) keeps transferring ±t every round.
+    assert dec2[1, 2] == -0.5
