@@ -65,6 +65,13 @@ class TrainStep:
     mesh : jax Mesh (default: all devices on one 'dp' axis).
     param_rule : callable(name, shape, mesh) -> PartitionSpec for tensor
         parallelism (default Megatron-ish rule in mesh.shard_params).
+    dtype : compute dtype for mixed precision (e.g. 'bfloat16'). Master
+        weights and optimizer state stay fp32 — params/activations are
+        cast inside the compiled step (XLA fuses the casts into the
+        matmuls/convs, which then run bf16 on the MXU) and gradients flow
+        back to the fp32 masters. This is the reference's multi_precision
+        / mp_sgd_update contract (python/mxnet/optimizer.py:201-266,
+        src/operator/optimizer_op.cc mp_sgd) in XLA form.
     """
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
@@ -98,7 +105,7 @@ class TrainStep:
     def _materialize(self, x_example):
         """Collect param values (triggering deferred init with a real
         forward if needed) and lay them out on the mesh."""
-        net, optimizer, dtype = self.net, self.optimizer, self._dtype
+        net, optimizer = self.net, self.optimizer
         params = list(net.collect_params().values())
         if any(p._data is None and p._deferred_init is not None
                for p in params):
@@ -107,9 +114,11 @@ class TrainStep:
             params = list(net.collect_params().values())
         self._train_params = [p for p in params if p.grad_req != "null"]
         self._aux_params = [p for p in params if p.grad_req == "null"]
-        get = lambda p: p.data()._data if dtype is None else \
-            p.data()._data.astype(dtype)
-        self._param_vals = {p.name: get(p) for p in self._train_params}
+        # Masters stay in the param's own (fp32) dtype even under mixed
+        # precision; the cast to the compute dtype happens inside the
+        # compiled step.
+        self._param_vals = {p.name: p.data()._data
+                            for p in self._train_params}
         self._aux_vals = {p.name: p.data()._data for p in self._aux_params}
 
         # Optimizer state mirrors param sharding (ZeRO-0; the state is
@@ -156,17 +165,37 @@ class TrainStep:
         beta1, beta2, epsilon = self.beta1, self.beta2, self.epsilon
         rescale = self.rescale_grad
 
+        cdt = None if self._dtype is None else jnp.dtype(self._dtype)
+
         def loss_of(pvals, aux_vals, x, y, key):
-            mapping = {p: NDArray(pvals[p.name]) for p in train_params}
-            mapping.update({p: NDArray(aux_vals[p.name]) for p in aux_params})
+            # Mixed precision: cast fp32 masters (and inputs/aux) to the
+            # compute dtype here, inside the traced step — XLA fuses the
+            # casts, the MXU runs bf16, and autodiff carries gradients
+            # back through the casts to the fp32 masters.
+            cast = (lambda a: a) if cdt is None else \
+                (lambda a: a.astype(cdt) if jnp.issubdtype(a.dtype,
+                                                           jnp.floating)
+                 else a)
+            mapping = {p: NDArray(cast(pvals[p.name])) for p in train_params}
+            # Aux (BN running stats) stay fp32: in train mode they sit
+            # only on the EMA-update path, so the moments accumulate in
+            # fp32 (the reference's AccReal contract) while activations
+            # stay in the compute dtype.
+            mapping.update({p: NDArray(aux_vals[p.name])
+                            for p in aux_params})
             ov = override(mapping)
             with autograd.pause(train_mode=True), \
                     _random.trace_key_scope(key), ov:
-                out = net(NDArray(x))
+                out = net(NDArray(cast(x)))
+                if cdt is not None:
+                    # Loss math in fp32 regardless of compute dtype.
+                    out = NDArray(out._data.astype(jnp.float32))
                 loss = loss_fn(out, NDArray(y))
             new_aux = dict(aux_vals)
             for p, v in ov.writes.items():
-                new_aux[p.name] = v._data if isinstance(v, NDArray) else v
+                nv = v._data if isinstance(v, NDArray) else v
+                # Running stats keep their stored (fp32) dtype.
+                new_aux[p.name] = nv.astype(aux_vals[p.name].dtype)
             return jnp.mean(loss._data), new_aux
 
         clip = self.clip_gradient

@@ -104,3 +104,30 @@ def test_train_step_sync_to_net():
     step(X, Y)
     step.sync_to_net()
     assert not np.allclose(w0, net.weight.data().asnumpy())
+
+
+def test_train_step_bf16_mixed_precision():
+    """dtype='bfloat16' keeps fp32 master weights (mp_sgd contract:
+    reference optimizer.py:201-266) while computing in bf16, and still
+    converges."""
+    mesh = make_mesh({"dp": 8})
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(2))
+    net.initialize()
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.5},
+                     mesh=mesh, dtype="bfloat16")
+    rng = np.random.RandomState(3)
+    X = rng.randn(64, 10).astype(np.float32)
+    w = rng.randn(10).astype(np.float32)
+    Y = (X @ w > 0).astype(np.float32)
+    losses = [float(jax.device_get(step(X, Y))) for _ in range(30)]
+    # Masters and optimizer state stayed fp32.
+    for n, v in step._param_vals.items():
+        assert v.dtype == np.float32, (n, v.dtype)
+    for n, s in step._opt_state.items():
+        assert s.dtype == np.float32, (n, s.dtype)
+    # Loss is fp32 and training progressed.
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
