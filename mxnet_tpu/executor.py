@@ -122,7 +122,7 @@ class Executor:
                 if n._op is None:
                     continue
                 g = n._attrs.get("__ctx_group__")
-                self._node_device[id(n)] = (group_dev[g] if g is not None
+                self._node_device[n._uid] = (group_dev[g] if g is not None
                                             else default_dev)
 
     # -- graph evaluation -----------------------------------------------------
@@ -135,7 +135,7 @@ class Executor:
         aux_writes = {}
 
         def value_of(node, out_index):
-            key = (id(node), out_index)
+            key = (node._uid, out_index)
             if key in results:
                 return results[key]
             if node._op is None:
@@ -147,7 +147,7 @@ class Executor:
             op = _registry.get(op_name)
             in_vals = [value_of(i, i._out_index or 0) for i in node._inputs]
             in_vals = _registry.prep_inputs(op, in_vals)
-            dev = self._node_device.get(id(node))
+            dev = self._node_device.get(node._uid)
             if dev is not None:
                 # cross-device copy at group boundaries (reference
                 # _CrossDeviceCopy): inputs move to this op's device.
@@ -168,9 +168,9 @@ class Executor:
                     aux_writes[a._name] = v
                 outs = outs[:1]
             for i, o in enumerate(outs):
-                results[(id(node), i)] = o
-            results[(id(node), None)] = outs[0]
-            return results[(id(node), out_index)]
+                results[(node._uid, i)] = o
+            results[(node._uid, None)] = outs[0]
+            return results[(node._uid, out_index)]
 
         out_vals = [value_of(s, s._out_index or 0) for s in out_syms]
         return out_vals, aux_writes

@@ -17,5 +17,6 @@ from . import optimizer_ops  # noqa: F401
 from . import sequence  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import control_flow  # noqa: F401
 
 __all__ = ["registry", "register", "get", "list_all_ops", "OP_REGISTRY"]

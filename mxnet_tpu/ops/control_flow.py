@@ -1,0 +1,169 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc:476-539 (`_foreach`,
+`_while_loop`, `_cond` executing sub-CachedOps per iteration/branch) and
+python/mxnet/ndarray/contrib.py + symbol/contrib.py wrappers.
+
+TPU rebuild: the sub-graph becomes the body of the native XLA structured
+primitive — `lax.scan` for foreach (one compiled loop, the MXU-friendly
+form the reference's fused RNN already uses), a masked `lax.scan` for
+while_loop (fixed trip count = max_iterations with an `active` predicate
+carried through — XLA requires static trip counts for reverse-mode
+autodiff, and masking preserves exactly the reference's semantics for
+the executed prefix), and `lax.cond` for cond. Gradients come from JAX
+autodiff through the structured primitive — the reference needed
+hand-written backward passes per control-flow op.
+
+The `body`/`cond`/`func` attrs are callables
+``(explicit_inputs..., captured) -> outputs``: python closures on the
+imperative path, `_SymSubgraph` graph-evaluators on the symbolic path
+(the reference passes sub-Symbols and cuts captured variables into
+explicit inputs the same way, control_flow.cc).
+"""
+from __future__ import annotations
+
+from .registry import register
+
+__all__ = ["SymSubgraph", "eval_subsymbol"]
+
+
+# ---------------------------------------------------------------------------
+# sub-symbol evaluation (symbolic frontend)
+# ---------------------------------------------------------------------------
+
+def eval_subsymbol(out_syms, values):
+    """Evaluate symbol DAG outputs given leaf-variable `values`
+    (name -> jax value). The control-flow analogue of
+    Executor._eval_graph, minus aux-write routing and device groups —
+    sub-graphs run wherever the enclosing executable runs."""
+    from . import registry as _reg
+    from .. import autograd
+
+    results = {}
+
+    def value_of(node, idx):
+        key = (node._uid, idx)
+        if key in results:
+            return results[key]
+        if node._op is None:
+            val = values[node._name]
+            results[key] = val
+            return val
+        op_name = node._attrs.get("_op_name", node._op)
+        op = _reg.get(op_name)
+        in_vals = [value_of(i, i._out_index or 0) for i in node._inputs]
+        in_vals = _reg.prep_inputs(op, in_vals)
+        attrs = node._clean_attrs()
+        if op.train_aware:
+            attrs = dict(attrs, training=autograd.is_training())
+        raw = op.bound_fn(attrs)(*in_vals)
+        outs = raw if isinstance(raw, (tuple, list)) else (raw,)
+        for i, o in enumerate(outs):
+            results[(node._uid, i)] = o
+        return results[key]
+
+    return [value_of(s, s._out_index or 0) for s in out_syms]
+
+
+class SymSubgraph:
+    """A symbol sub-graph as a callable for the control-flow ops.
+
+    `arg_names` are the placeholder variables fed per call (data slices /
+    loop vars); `captured_names` are enclosing-graph values cut into
+    explicit op inputs (the reference's subgraph-cut of free variables).
+    """
+
+    def __init__(self, arg_names, captured_names, out_syms):
+        self.arg_names = list(arg_names)
+        self.captured_names = list(captured_names)
+        self.out_syms = list(out_syms)
+
+    def __call__(self, args, captured):
+        values = dict(zip(self.arg_names, args))
+        values.update(zip(self.captured_names, captured))
+        return eval_subsymbol(self.out_syms, values)
+
+
+# ---------------------------------------------------------------------------
+# the ops
+# ---------------------------------------------------------------------------
+
+@register("_foreach", num_inputs=None)
+def _foreach(*arrays, body=None, n_data=1, n_states=0):
+    """Scan `body` over axis 0 of the data arrays.
+
+    body(data_slices + states, captured) -> list of step outputs
+    followed by n_states new states (output count is read off the
+    result). Returns stacked outputs + final states (reference foreach
+    semantics, control_flow.cc:476).
+    """
+    from jax import lax
+
+    data = tuple(arrays[:n_data])
+    states = tuple(arrays[n_data:n_data + n_states])
+    captured = list(arrays[n_data + n_states:])
+
+    def step(carry, xs):
+        res = body(list(xs) + list(carry), captured)
+        n_outs = len(res) - n_states
+        outs, new_states = res[:n_outs], res[n_outs:]
+        return tuple(new_states), tuple(outs)
+
+    final, stacked = lax.scan(step, states, data)
+    return tuple(stacked) + tuple(final)
+
+
+@register("_while_loop", num_inputs=None)
+def _while_loop(*arrays, cond=None, func=None, n_vars=1,
+                max_iterations=None):
+    """Masked fixed-length scan implementing while semantics.
+
+    cond(loop_vars, captured) -> scalar truth; func(loop_vars, captured)
+    -> step outputs + n_vars new loop vars. Runs exactly
+    `max_iterations` scan steps; iterations past the point where cond
+    first fails are masked out (outputs zero, vars frozen), matching the
+    reference's executed-prefix semantics (control_flow.cc:_while_loop)
+    while staying reverse-differentiable under XLA. The final output is
+    the per-step validity mask (callers derive the executed step count).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    loop_vars = tuple(arrays[:n_vars])
+    captured = list(arrays[n_vars:])
+
+    def step(carry, _):
+        vars_, active = carry
+        c = jnp.logical_and(
+            active,
+            jnp.squeeze(cond(list(vars_), captured)[0]).astype(bool))
+        res = func(list(vars_), captured)
+        n_outs = len(res) - n_vars
+        outs, new_vars = res[:n_outs], res[n_outs:]
+        sel = tuple(jnp.where(c, nv, v) for nv, v in zip(new_vars, vars_))
+        masked = tuple(jnp.where(c, o, jnp.zeros_like(o)) for o in outs)
+        return (sel, c), masked + (c,)
+
+    (final_vars, _), scanned = lax.scan(
+        step, (loop_vars, jnp.asarray(True)), None,
+        length=int(max_iterations))
+    outs, valid = scanned[:-1], scanned[-1]
+    return tuple(outs) + tuple(final_vars) + (valid,)
+
+
+@register("_cond", num_inputs=None)
+def _cond(*arrays, pred=None, then_g=None, else_g=None):
+    """Run then_g or else_g on `arrays` depending on pred(arrays)
+    (reference control_flow.cc:_cond → lax.cond: both branches traced,
+    one executed). All three callables take ([], captured) — every input
+    is a captured value of the enclosing graph."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    captured = list(arrays)
+    p = jnp.squeeze(pred([], captured)[0]).astype(bool)
+    return lax.cond(
+        p,
+        lambda xs: tuple(then_g([], list(xs))),
+        lambda xs: tuple(else_g([], list(xs))),
+        captured)

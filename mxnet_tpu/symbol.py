@@ -157,8 +157,10 @@ def _auto_name(hint):
 class Symbol:
     """A node in the symbolic graph (reference symbol.py:Symbol)."""
 
+    _uid_counter = [0]
+
     def __init__(self, op, attrs=None, inputs=None, name=None, is_aux=False,
-                 out_index=None, num_outputs=1):
+                 out_index=None, num_outputs=1, uid=None):
         self._op = op  # None => variable; "_group" => output group
         self._attrs = dict(attrs or {})
         self._inputs = list(inputs or [])
@@ -166,6 +168,14 @@ class Symbol:
         self._is_aux = is_aux
         self._out_index = out_index
         self._num_outputs = num_outputs
+        # Stable logical-node identity: output views (node[i]) share their
+        # base node's uid so evaluation/shape/serialization caches treat
+        # them as one node (a view is the same computation, different
+        # output slot).
+        if uid is None:
+            Symbol._uid_counter[0] += 1
+            uid = Symbol._uid_counter[0]
+        self._uid = uid
 
     # -- identity -------------------------------------------------------------
 
@@ -203,9 +213,9 @@ class Symbol:
         order = []
 
         def visit(node):
-            if id(node) in seen:
+            if node._uid in seen:
                 return
-            seen.add(id(node))
+            seen.add(node._uid)
             for i in node._inputs:
                 visit(i)
             order.append(node)
@@ -255,7 +265,8 @@ class Symbol:
                     raise IndexError(index)
                 return self
             return Symbol(self._op, self._attrs, self._inputs, self._name,
-                          out_index=index, num_outputs=self._num_outputs)
+                          out_index=index, num_outputs=self._num_outputs,
+                          uid=self._uid)
         raise TypeError(index)
 
     def __len__(self):
@@ -310,6 +321,24 @@ class Symbol:
     def __neg__(self):
         return self.__mul__(-1.0)
 
+    # Comparisons compose broadcast/scalar logic ops (reference
+    # symbol.py __gt__/__lt__/... — note __eq__ stays python identity,
+    # as in the reference, so symbols remain dict/set-safe).
+    def __lt__(self, other):
+        return _invoke_cmp("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _invoke_cmp("broadcast_lesser_equal", "_lesser_equal_scalar",
+                           self, other)
+
+    def __gt__(self, other):
+        return _invoke_cmp("broadcast_greater", "_greater_scalar", self,
+                           other)
+
+    def __ge__(self, other):
+        return _invoke_cmp("broadcast_greater_equal",
+                           "_greater_equal_scalar", self, other)
+
     # -- shape/type inference -------------------------------------------------
 
     def infer_shape(self, *args, **kwargs):
@@ -327,7 +356,7 @@ class Symbol:
             return None, None, None
         arg_shapes = [shapes.get(n) for n in self.list_arguments()]
         aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
-        out_shapes = [shapes[("out", id(s), s._out_index or 0)]
+        out_shapes = [shapes[("out", s._uid, s._out_index or 0)]
                       for s in self.outputs]
         return arg_shapes, out_shapes, aux_shapes
 
@@ -354,7 +383,7 @@ class Symbol:
                 data = node._inputs[0]
                 dname = data._name if data._op is None else None
                 dshape = shapes.get(dname) if dname else \
-                    shapes.get(("out", id(data), data._out_index or 0))
+                    shapes.get(("out", data._uid, data._out_index or 0))
                 if dshape is not None:
                     param_shapes = rule(node._clean_attrs(), tuple(dshape))
                     for inp in node._inputs[1:]:
@@ -368,7 +397,7 @@ class Symbol:
             ok = True
             for inp in node._inputs:
                 s = shapes.get(inp._name) if inp._op is None else \
-                    shapes.get(("out", id(inp), inp._out_index or 0))
+                    shapes.get(("out", inp._uid, inp._out_index or 0))
                 if s is None:
                     ok = False
                     break
@@ -391,8 +420,8 @@ class Symbol:
                                  % (node._name or op_name, e)) from None
             outs = out if isinstance(out, (tuple, list)) else (out,)
             for i, o in enumerate(outs):
-                shapes[("out", id(node), i)] = tuple(o.shape)
-            shapes[("out", id(node), None)] = tuple(outs[0].shape)
+                shapes[("out", node._uid, i)] = tuple(o.shape)
+            shapes[("out", node._uid, None)] = tuple(outs[0].shape)
         return shapes
 
     def infer_type(self, **kwargs):
@@ -414,19 +443,19 @@ class Symbol:
         """JSON graph (reference symbol.py:tojson; format is own but
         stable — nodes with op/name/attrs/input indices)."""
         order = [n for n in self._topo() if n._op != "_group"]
-        index = {id(n): i for i, n in enumerate(order)}
+        index = {n._uid: i for i, n in enumerate(order)}
         nodes = []
         for n in order:
             nodes.append({
                 "op": n._op or "null",
                 "name": n._name,
                 "attrs": _jsonify_attrs(n._attrs),
-                "inputs": [[index[id(i)], i._out_index or 0] for i in n._inputs],
+                "inputs": [[index[i._uid], i._out_index or 0] for i in n._inputs],
                 "is_aux": n._is_aux,
                 "out_index": n._out_index,
                 "num_outputs": n._num_outputs,
             })
-        heads = [[index[id(s)], s._out_index or 0] for s in self.outputs]
+        heads = [[index[s._uid], s._out_index or 0] for s in self.outputs]
         return json.dumps({"nodes": nodes, "heads": heads,
                            "mxnet_tpu_version": 1}, indent=2)
 
@@ -559,6 +588,12 @@ def _as_symbol(x, ref_name="scalar"):
     raise TypeError("expected Symbol, got %r" % (x,))
 
 
+def _invoke_cmp(op_name, scalar_op_name, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return _make_symbol_op(op_name)(lhs, rhs)
+    return _make_symbol_op(scalar_op_name)(lhs, scalar=float(rhs))
+
+
 def _invoke_sym(op_name, lhs, rhs):
     """Binary operator composition, scalar-aware (reference: the
     _internal _plus/_plus_scalar split)."""
@@ -676,5 +711,11 @@ def arange(start, stop=None, step=1.0, **kwargs):
 def __getattr__(name):
     if name.startswith("__"):
         raise AttributeError(name)
+    if name == "contrib":
+        import importlib
+
+        mod = importlib.import_module(".symbol_contrib", "mxnet_tpu")
+        globals()["contrib"] = mod
+        return mod
     _registry.get(name)  # raises AttributeError if unknown
     return _make_symbol_op(name)

@@ -1,0 +1,277 @@
+"""Control-flow op semantics, ported from the reference
+tests/python/unittest/test_contrib_control_flow.py (foreach with states,
+while_loop exact/padded semantics, cond branch selection, gradients
+through the loop, symbolic bind + backward)."""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray import contrib as ndc
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    init = mx.nd.zeros((3,))
+
+    def body(x, s):
+        new_s = s + x
+        return new_s * 1.0, new_s
+
+    outs, final = ndc.foreach(body, data, init)
+    expected = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), expected)
+    np.testing.assert_allclose(final.asnumpy(), expected[-1])
+
+
+def test_foreach_multi_data_multi_state():
+    a = mx.nd.array(np.arange(6).reshape(3, 2).astype(np.float32))
+    b = mx.nd.array(np.ones((3, 2), np.float32))
+    s1 = mx.nd.zeros((2,))
+    s2 = mx.nd.ones((2,))
+
+    def body(xs, states):
+        xa, xb = xs
+        t1, t2 = states
+        return [xa + xb + t1, xa * t2], [t1 + xa, t2 * 2]
+
+    outs, finals = ndc.foreach(body, [a, b], [s1, s2])
+    assert len(outs) == 2 and len(finals) == 2
+    an = np.arange(6).reshape(3, 2).astype(np.float32)
+    t1 = np.zeros(2, np.float32)
+    t2 = np.ones(2, np.float32)
+    o1, o2 = [], []
+    for t in range(3):
+        o1.append(an[t] + 1 + t1)
+        o2.append(an[t] * t2)
+        t1 = t1 + an[t]
+        t2 = t2 * 2
+    np.testing.assert_allclose(outs[0].asnumpy(), np.stack(o1))
+    np.testing.assert_allclose(outs[1].asnumpy(), np.stack(o2))
+    np.testing.assert_allclose(finals[0].asnumpy(), t1)
+    np.testing.assert_allclose(finals[1].asnumpy(), t2)
+
+
+def test_foreach_gradient_matches_unrolled():
+    """Gradient through foreach == gradient of a hand-unrolled loop."""
+    np.random.seed(0)
+    data_np = np.random.rand(5, 4).astype(np.float32)
+    w_np = np.random.rand(4).astype(np.float32)
+
+    def run_foreach():
+        data = mx.nd.array(data_np)
+        w = mx.nd.array(w_np)
+        w.attach_grad()
+        with autograd.record():
+            outs, final = ndc.foreach(
+                lambda x, s: (x * w, s + (x * w).sum()),
+                data, mx.nd.zeros((1,)))
+            loss = (outs * outs).sum() + final.sum()
+        loss.backward()
+        return w.grad.asnumpy()
+
+    def run_unrolled():
+        data = mx.nd.array(data_np)
+        w = mx.nd.array(w_np)
+        w.attach_grad()
+        with autograd.record():
+            s = mx.nd.zeros((1,))
+            outs = []
+            for t in range(5):
+                o = data[t] * w
+                s = s + o.sum()
+                outs.append(o)
+            stacked = mx.nd.stack(*outs, axis=0)
+            loss = (stacked * stacked).sum() + s.sum()
+        loss.backward()
+        return w.grad.asnumpy()
+
+    np.testing.assert_allclose(run_foreach(), run_unrolled(), rtol=1e-5)
+
+
+def test_while_loop_imperative_exact_length():
+    """Imperative while_loop returns exactly the executed steps
+    (reference: nd while_loop semantics)."""
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return i * 2.0, [i + 1, s + i]
+
+    outs, finals = ndc.while_loop(
+        cond, func, [mx.nd.array([0.0]), mx.nd.array([0.0])],
+        max_iterations=10)
+    assert outs.shape == (5, 1)
+    np.testing.assert_allclose(outs.asnumpy().reshape(-1),
+                               [0, 2, 4, 6, 8])
+    np.testing.assert_allclose(finals[0].asnumpy(), [5.0])
+    np.testing.assert_allclose(finals[1].asnumpy(), [10.0])
+
+
+def test_while_loop_traced_padded():
+    """Traced while_loop pads outputs to max_iterations with zeros."""
+    def run(i0):
+        outs, finals = ndc.while_loop(
+            lambda i: i < 5, lambda i: (i * 2.0, [i + 1]),
+            [mx.nd.from_jax(i0)], max_iterations=8)
+        return outs._data, finals[0]._data
+
+    outs, final = jax.jit(run)(jax.numpy.asarray([0.0]))
+    np.testing.assert_allclose(np.asarray(outs).reshape(-1),
+                               [0, 2, 4, 6, 8, 0, 0, 0])
+    np.testing.assert_allclose(np.asarray(final), [5.0])
+
+
+def test_cond_imperative_and_traced():
+    x = mx.nd.array([2.0])
+    y = mx.nd.array([3.0])
+    out = ndc.cond(lambda: x.sum() < y.sum(),
+                   lambda: x * 2, lambda: y * 2)
+    np.testing.assert_allclose(out.asnumpy(), [4.0])
+
+    def run(xv, yv):
+        xa, ya = mx.nd.from_jax(xv), mx.nd.from_jax(yv)
+        out = ndc.cond(lambda: xa.sum() < ya.sum(),
+                       lambda: xa * 2, lambda: ya * 2)
+        return out._data
+
+    r = jax.jit(run)(jax.numpy.asarray([5.0]), jax.numpy.asarray([3.0]))
+    np.testing.assert_allclose(np.asarray(r), [6.0])
+
+
+def test_cond_records_gradient():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        out = ndc.cond(lambda: x.sum() > 0,
+                       lambda: x * x, lambda: x * 4)
+    out.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_sym_foreach_bind():
+    """Symbolic foreach: RNN-ish accumulation with a captured weight,
+    bound and executed (+ backward)."""
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    init = mx.sym.var("init")
+
+    def body(x, s):
+        h = mx.sym.broadcast_mul(x, w) + s
+        return h, h
+
+    outs, final = mx.sym.contrib.foreach(body, data, init)
+    out = mx.sym.Group([outs, final])
+    data_np = np.arange(6).reshape(3, 2).astype(np.float32)
+    w_np = np.array([2.0, 0.5], np.float32)
+    ex = out.bind(mx.cpu(), {"data": mx.nd.array(data_np),
+                             "w": mx.nd.array(w_np),
+                             "init": mx.nd.zeros((2,))},
+                  args_grad={"w": mx.nd.zeros((2,))})
+    res = ex.forward(is_train=True)
+    s = np.zeros(2, np.float32)
+    expect = []
+    for t in range(3):
+        s = data_np[t] * w_np + s
+        expect.append(s)
+    np.testing.assert_allclose(res[0].asnumpy(), np.stack(expect),
+                               rtol=1e-6)
+    np.testing.assert_allclose(res[1].asnumpy(), s, rtol=1e-6)
+    ex.backward(out_grads=[mx.nd.ones((3, 2)), mx.nd.zeros((2,))])
+    # d(sum of outs)/dw: each out_t = sum_{i<=t} x_i * w  =>
+    # grad_w = sum_t sum_{i<=t} x_i
+    gw = sum(data_np[i] * (3 - i) for i in range(3))
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), gw, rtol=1e-5)
+
+
+def test_sym_while_loop_bind():
+    i = mx.sym.var("i")
+    outs, finals = mx.sym.contrib.while_loop(
+        lambda i_: i_ < 4, lambda i_: (i_ * 3.0, [i_ + 1]),
+        [i], max_iterations=6)
+    grp = mx.sym.Group([outs] + finals)
+    ex = grp.bind(mx.cpu(), {"i": mx.nd.array([0.0])})
+    res = ex.forward()
+    np.testing.assert_allclose(res[0].asnumpy().reshape(-1),
+                               [0, 3, 6, 9, 0, 0])
+    np.testing.assert_allclose(res[1].asnumpy(), [4.0])
+
+
+def test_sym_cond_bind():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out = mx.sym.contrib.cond(lambda: mx.sym.sum(a) > mx.sym.sum(b),
+                              lambda: a * 2, lambda: b * 3)
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array([4.0]),
+                             "b": mx.nd.array([1.0])})
+    res = ex.forward()
+    np.testing.assert_allclose(res[0].asnumpy(), [8.0])
+    ex2 = out.bind(mx.cpu(), {"a": mx.nd.array([0.5]),
+                              "b": mx.nd.array([1.0])})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(), [3.0])
+
+
+def test_foreach_in_hybrid_block():
+    """foreach inside a hybridized block fuses into the cached executable
+    (the CachedOp seam: whole loop = one lax.scan in one XLA program)."""
+    from mxnet_tpu import gluon
+
+    class Cumul(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, final = ndc.foreach(
+                lambda xt, s: (s + xt, s + xt), x, mx.nd.zeros((2,)))
+            return outs
+
+    net = Cumul()
+    net.hybridize()
+    x = mx.nd.array(np.ones((4, 2), np.float32))
+    out = net(x)
+    np.testing.assert_allclose(
+        out.asnumpy(), np.cumsum(np.ones((4, 2)), axis=0))
+
+
+def test_multi_output_node_evaluates_once():
+    """Output views (node[i]) share evaluation: a foreach consumed via
+    several outputs runs its scan exactly once per forward, and
+    outs[-1] == final state even with RNG in the body."""
+    calls = {"n": 0}
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+
+    def body(x, s):
+        h = x + s
+        return h, h
+
+    outs, final = mx.sym.contrib.foreach(body, data, init)
+    # count scan traces via the subgraph callable
+    node_attrs = outs._attrs
+    orig = node_attrs["body"]
+
+    class Counting:
+        def __call__(self, args, captured):
+            calls["n"] += 1
+            return orig(args, captured)
+
+    node_attrs["body"] = Counting()
+    grp = mx.sym.Group([outs, final])
+    ex = grp.bind(mx.cpu(), {"data": mx.nd.ones((3, 2)),
+                             "init": mx.nd.zeros((2,))})
+    res = ex.forward()
+    np.testing.assert_allclose(res[0].asnumpy()[-1], res[1].asnumpy())
+    # lax.scan traces the body a few times for one compilation, but a
+    # second consumed output must NOT double it.
+    first = calls["n"]
+    assert first > 0
+    ex2 = grp.bind(mx.cpu(), {"data": mx.nd.ones((3, 2)),
+                              "init": mx.nd.zeros((2,))})
+    ex2.forward()
+    assert calls["n"] == 2 * first  # once per bind/compile, not per output
+
+
+def test_foreach_empty_data():
+    outs, final = ndc.foreach(lambda x, s: (x + s, s + 1),
+                              mx.nd.zeros((0, 3)), mx.nd.zeros((3,)))
+    assert outs == []
+    np.testing.assert_allclose(final.asnumpy(), np.zeros(3))
