@@ -1,0 +1,65 @@
+"""mx.contrib.io — adapters between Gluon data loaders and the DataIter
+API (reference: python/mxnet/contrib/io.py:DataLoaderIter)."""
+from __future__ import annotations
+
+from ..io import DataIter, DataBatch, DataDesc
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Expose a gluon.data.DataLoader as a Module-compatible DataIter
+    (reference contrib/io.py:DataLoaderIter)."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__(batch_size=getattr(loader, "_batch_sampler", None)
+                         and loader._batch_sampler._batch_size or 0)
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        self._dtype = dtype
+        self._first = next(self._iter)
+        self._restart = False
+
+    def _split(self, batch):
+        if isinstance(batch, (list, tuple)):
+            data, label = batch[0], batch[1] if len(batch) > 1 else None
+        else:
+            data, label = batch, None
+        return data, label
+
+    @property
+    def provide_data(self):
+        data, _ = self._split(self._first)
+        return [DataDesc(self._data_name, data.shape, self._dtype)]
+
+    @property
+    def provide_label(self):
+        _, label = self._split(self._first)
+        if label is None:
+            return []
+        return [DataDesc(self._label_name, label.shape, self._dtype)]
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._restart = True
+
+    def next(self):
+        if self._restart:
+            self._restart = False
+            batch = next(self._iter, None)
+        elif self._first is not None:
+            batch, self._first = self._first, None
+            return self._wrap(batch)
+        else:
+            batch = next(self._iter, None)
+        if batch is None:
+            raise StopIteration
+        return self._wrap(batch)
+
+    def _wrap(self, batch):
+        data, label = self._split(batch)
+        return DataBatch(data=[data],
+                         label=[label] if label is not None else [])

@@ -1,0 +1,10 @@
+"""gluon.contrib — experimental Gluon layers/cells/samplers.
+
+Reference: python/mxnet/gluon/contrib/ (nn basic layers, rnn cells incl.
+VariationalDropout and convolutional RNN cells, data samplers).
+"""
+from . import nn
+from . import rnn
+from . import data
+
+__all__ = ["nn", "rnn", "data"]

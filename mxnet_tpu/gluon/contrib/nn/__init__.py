@@ -1,0 +1,6 @@
+"""gluon.contrib.nn (reference: python/mxnet/gluon/contrib/nn)."""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, SyncBatchNorm)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]

@@ -70,27 +70,34 @@ def _terminate_pool(pool):
         pass
 
 
-def _worker_initializer(dataset):
+def _worker_initializer(dataset, is_child_process):
     # Dataset is sent once at pool startup, not per batch (reference
     # dataloader.py:worker_loop receives the dataset through the fork).
     global _worker_dataset
     _worker_dataset = dataset
     # Enforce the "workers never touch the TPU client" contract (the
     # reference quiesces its engine across fork, src/initialize.cc:52):
-    # a forked child that accidentally calls into jax must not try to
+    # a worker process that accidentally calls into jax must not try to
     # grab the accelerator — pin any fresh backend resolution to cpu.
-    # Only in a real child process: with thread_pool=True this
-    # initializer runs inside the parent, whose env must stay untouched.
-    import multiprocessing as _mp
-    import os
+    # `is_child_process` is passed explicitly by the pool constructor:
+    # with thread_pool=True this initializer runs on threads *inside the
+    # training process*, whose env must stay untouched (querying
+    # multiprocessing parentage here would misfire when the trainer
+    # itself was spawned via multiprocessing).
+    if is_child_process:
+        import os
 
-    if _mp.parent_process() is not None:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
 
-def _worker_fn(samples, batchify_fn):
+def _worker_fn(samples, batchify_fn, dataset=None):
+    """`dataset` is passed explicitly by thread pools (several loaders
+    share one process, so a module global would be clobbered by the
+    most recently constructed loader); process-pool workers use the
+    per-process global installed by the initializer."""
     try:
-        batch = batchify_fn([_worker_dataset[i] for i in samples])
+        ds = dataset if dataset is not None else _worker_dataset
+        batch = batchify_fn([ds[i] for i in samples])
         return _as_numpy(batch)
     except Exception as e:  # captured, not fatal to the pool
         return _WorkerError(e)
@@ -132,10 +139,11 @@ class _MultiWorkerIter:
     (reference dataloader.py:_MultiWorkerIter)."""
 
     def __init__(self, pool, batchify_fn, batch_sampler, prefetch,
-                 pin_memory=False):
+                 pin_memory=False, dataset=None):
         self._pool = pool
         self._batchify_fn = batchify_fn
         self._pin_memory = pin_memory
+        self._dataset = dataset          # non-None only for thread pools
         self._iter = iter(batch_sampler)
         self._data_buffer = {}
         self._rcvd_idx = 0
@@ -147,8 +155,8 @@ class _MultiWorkerIter:
         r = next(self._iter, None)
         if r is None:
             return
-        async_ret = self._pool.apply_async(_worker_fn,
-                                           (r, self._batchify_fn))
+        async_ret = self._pool.apply_async(
+            _worker_fn, (r, self._batchify_fn, self._dataset))
         self._data_buffer[self._sent_idx] = async_ret
         self._sent_idx += 1
 
@@ -215,12 +223,24 @@ class DataLoader:
 
                 self._pool = ThreadPool(
                     self._num_workers,
-                    initializer=_worker_initializer, initargs=(dataset,))
+                    initializer=_worker_initializer,
+                    initargs=(dataset, False))
             else:
-                ctx = mp.get_context("fork")
+                # Default start method is fork (fast; workers run only
+                # numpy by contract). Forking a process with live JAX
+                # threads is flagged by CPython — set
+                # MXNET_WORKER_START_METHOD=forkserver|spawn to trade
+                # startup cost for a thread-clean child (then the
+                # dataset must be picklable).
+                import os
+
+                method = os.environ.get("MXNET_WORKER_START_METHOD",
+                                        "fork")
+                ctx = mp.get_context(method)
                 self._pool = ctx.Pool(
                     self._num_workers,
-                    initializer=_worker_initializer, initargs=(dataset,))
+                    initializer=_worker_initializer,
+                    initargs=(dataset, True))
             # finalize() runs at gc or atexit — BEFORE interpreter
             # teardown, unlike __del__, so the pool shuts down while
             # multiprocessing internals are still alive.
@@ -237,7 +257,9 @@ class DataLoader:
             return same_process_iter()
         return _MultiWorkerIter(self._pool, self._batchify_fn,
                                 self._batch_sampler, self._prefetch,
-                                self._pin_memory)
+                                self._pin_memory,
+                                dataset=self._dataset
+                                if self._thread_pool else None)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -74,9 +74,16 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._stype = stype
+        # Gradient storage type (reference parameter.py: grad_stype
+        # 'row_sparse' makes the kvstore pull only touched rows).
+        self._grad_stype = grad_stype
         self._data = None  # dict ctx -> NDArray
         self._grad = None
         self._deferred_init = None
+
+    @property
+    def grad_stype(self):
+        return self._grad_stype
 
     @property
     def grad_req(self):

@@ -1,0 +1,138 @@
+"""INT8 quantization operators.
+
+Reference: src/operator/quantization/ (quantize.cc, quantize_v2.cc,
+dequantize.cc, requantize.cc, quantized_conv.cc, quantized_fully_connected.cc)
+— int8 inference with per-tensor symmetric scales and calibrated
+activation ranges.
+
+TPU rebuild: quantized conv/FC hold int8 weights; at run time the
+activation is quantized with its calibrated range, the product is
+accumulated wide, and the result is rescaled to fp32 in one fused
+epilogue. The arithmetic is expressed over the int8-valued tensors cast
+to f32 for the contraction — XLA's MXU lowers narrow-input matmuls
+natively where profitable (int8 MXU paths), and the numerics are the
+int8 numerics either way since fp32 represents every int8 product
+exactly. min/max ranges ride as op attrs (baked at calibration time,
+reference: *_calib_range node attrs from quantize_graph_pass.cc).
+"""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+QUANT_MIN, QUANT_MAX = -127.0, 127.0
+
+
+def _scale_of(min_range, max_range):
+    amax = max(abs(float(min_range)), abs(float(max_range))) or 1.0
+    return QUANT_MAX / amax
+
+
+@register("_contrib_quantize", differentiable=False)
+def _quantize(data, min_range, max_range, out_type="int8"):
+    """fp32 -> int8 with the given range (reference quantize.cc).
+    Returns (quantized, min_range, max_range)."""
+    jnp = _jnp()
+    scale = QUANT_MAX / jnp.maximum(
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)), 1e-12)
+    q = jnp.clip(jnp.round(data * scale), QUANT_MIN, QUANT_MAX)
+    return q.astype(jnp.int8), min_range, max_range
+
+
+@register("_contrib_quantize_v2", differentiable=False)
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8"):
+    """fp32 -> int8, range from calibration attrs or the data itself
+    (reference quantize_v2.cc)."""
+    jnp = _jnp()
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    scale = QUANT_MAX / jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)),
+                                    1e-12)
+    q = jnp.clip(jnp.round(data * scale), QUANT_MIN, QUANT_MAX)
+    return q.astype(jnp.int8), mn, mx
+
+
+@register("_contrib_dequantize", differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 -> fp32 (reference dequantize.cc)."""
+    jnp = _jnp()
+    scale = jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                    jnp.abs(max_range)), 1e-12) / QUANT_MAX
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", differentiable=False)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None):
+    """int32 accumulator -> int8 with a narrower calibrated range
+    (reference requantize.cc). Returns (q, new_min, new_max)."""
+    jnp = _jnp()
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        / (QUANT_MAX * QUANT_MAX))
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    else:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    scale = QUANT_MAX / jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)),
+                                    1e-12)
+    q = jnp.clip(jnp.round(real * scale), QUANT_MIN, QUANT_MAX)
+    return q.astype(jnp.int8), mn, mx
+
+
+def _quantize_act(jnp, data, min_data, max_data):
+    a_scale = _scale_of(min_data, max_data)
+    q = jnp.clip(jnp.round(data * a_scale), QUANT_MIN, QUANT_MAX)
+    return q, a_scale
+
+
+@register("_contrib_quantized_fully_connected", differentiable=False)
+def _quantized_fc(data, weight, bias=None, num_hidden=0, no_bias=False,
+                  flatten=True, min_data=0.0, max_data=0.0, w_scale=1.0):
+    """int8 FC: quantize activation with calibrated range, int8 x int8
+    contraction, fused rescale to fp32 (+fp32 bias)
+    (reference quantized_fully_connected.cc)."""
+    jnp = _jnp()
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    q, a_scale = _quantize_act(jnp, data, min_data, max_data)
+    acc = jnp.dot(q, weight.astype(jnp.float32).T)
+    out = acc / (a_scale * w_scale)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+@register("_contrib_quantized_conv", differentiable=False)
+def _quantized_conv(data, weight, bias=None, kernel=(), stride=(),
+                    dilate=(), pad=(), num_filter=0, num_group=1,
+                    no_bias=False, layout="NCHW", min_data=0.0,
+                    max_data=0.0, w_scale=1.0):
+    """int8 convolution with fused fp32 rescale epilogue
+    (reference quantized_conv.cc)."""
+    import jax.numpy as jnp
+
+    from .nn import _convolution
+
+    q, a_scale = _quantize_act(jnp, data, min_data, max_data)
+    acc = _convolution(q.astype(jnp.float32), weight.astype(jnp.float32),
+                       None, kernel=kernel, stride=stride, dilate=dilate,
+                       pad=pad, num_filter=num_filter, num_group=num_group,
+                       no_bias=True, layout=layout)
+    out = acc / (a_scale * w_scale)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * (out.ndim - 2))
+    return out

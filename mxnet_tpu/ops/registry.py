@@ -142,13 +142,30 @@ def prep_inputs(op: Operator, arrays):
     return arrays
 
 
+_profiler_mod = None
+
+
 def invoke_raw(op: Operator, arrays, attrs, named=()):
     """Run `op` on raw jax arrays, choosing traced-inline vs jitted path.
     Trailing `named` entries of `arrays` are bound by keyword."""
+    global _profiler_mod
     arrays = prep_inputs(op, arrays)
     attrs_key = _freeze(attrs)
     if _is_traced(arrays):
         # Inside an enclosing jit/vjp/vmap trace: inline so the whole
         # surrounding graph compiles as one executable.
         return op.bound_fn(attrs, named)(*arrays)
+    if _profiler_mod is None:
+        from .. import profiler as _profiler_mod_  # lazy, once
+
+        _profiler_mod = _profiler_mod_
+    if _profiler_mod.is_recording():
+        # Profiling: record the dispatch span (reference ExecuteOprBlock
+        # wraps each op in ProfileOperator, threaded_engine.h:338-347).
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = op.jitted(attrs_key, attrs, named)(*arrays)
+        _profiler_mod.record_op_span(op.name, _time.perf_counter() - t0)
+        return out
     return op.jitted(attrs_key, attrs, named)(*arrays)

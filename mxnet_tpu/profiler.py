@@ -1,0 +1,243 @@
+"""mx.profiler — profiling over jax.profiler plus framework-level
+aggregate statistics.
+
+Reference: python/mxnet/profiler.py:29-257 (set_config/set_state/pause/
+resume/dump/dumps + user-defined Domain/Task/Frame/Counter/Marker) over
+src/profiler/profiler.h:256 (chrome://tracing JSON spans, aggregate
+summary tables from aggregate_stats.cc).
+
+TPU rebuild, two layers:
+
+1. Device/XLA level — delegated to `jax.profiler`: `set_state('run')`
+   starts a trace capture whose output (TensorBoard/XPlane format, the
+   modern chrome-trace equivalent; profiler.h:87 wrote chrome JSON)
+   lands in the configured directory with per-HLO device timing.
+2. Framework level — the dispatch path records per-op wall-time spans
+   (op name, count, total/min/max) whenever profiling is on, feeding
+   `dumps()` aggregate tables like the reference's AggregateStats. On an
+   async backend these measure *dispatch* cost, not device cost — the
+   device truth lives in the trace files; both are stated in the output
+   header.
+
+User-defined objects (Domain/Task/Frame/Counter/Marker) record into the
+same framework-level event log.
+"""
+from __future__ import annotations
+
+import os
+import time
+import threading
+
+__all__ = ["set_config", "profiler_set_config", "set_state",
+           "profiler_set_state", "pause", "resume", "dump", "dumps",
+           "Domain", "Task", "Frame", "Counter", "Marker"]
+
+_state = {
+    "running": False,
+    "paused": False,
+    "config": {"filename": "profile_output", "profile_all": False,
+               "profile_symbolic": True, "profile_imperative": True,
+               "profile_api": True, "aggregate_stats": True},
+    "trace_active": False,
+}
+_lock = threading.Lock()
+_op_stats = {}       # name -> [count, total_s, min_s, max_s]
+_counters = {}       # (domain, name) -> value
+_events = []         # (timestamp, kind, name, info)
+
+
+def set_config(**kwargs):
+    """(reference profiler.py:set_config). Accepts the reference's knobs;
+    `filename` names the trace output directory for jax.profiler."""
+    _state["config"].update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def _trace_dir():
+    base = _state["config"].get("filename", "profile_output")
+    # reference writes one json file; jax.profiler writes a directory.
+    if base.endswith(".json"):
+        base = base[:-5]
+    return base
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' starts device tracing + op-span recording; 'stop' ends it
+    (reference profiler.py:set_state)."""
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    if state == "run" and not _state["running"]:
+        _state["running"] = True
+        _state["paused"] = False
+        try:
+            import jax
+
+            os.makedirs(_trace_dir(), exist_ok=True)
+            jax.profiler.start_trace(_trace_dir())
+            _state["trace_active"] = True
+        except Exception:
+            _state["trace_active"] = False  # framework-level only
+    elif state == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["trace_active"]:
+            import jax
+
+            jax.profiler.stop_trace()
+            _state["trace_active"] = False
+
+
+profiler_set_state = set_state
+
+
+def pause(profile_process="worker"):
+    """Suspend op-span recording (reference profiler.py:pause)."""
+    _state["paused"] = True
+
+
+def resume(profile_process="worker"):
+    _state["paused"] = False
+
+
+def is_recording():
+    return _state["running"] and not _state["paused"]
+
+
+def record_op_span(name, seconds):
+    """Called from the dispatch path for each op while profiling."""
+    with _lock:
+        st = _op_stats.get(name)
+        if st is None:
+            _op_stats[name] = [1, seconds, seconds, seconds]
+        else:
+            st[0] += 1
+            st[1] += seconds
+            st[2] = min(st[2], seconds)
+            st[3] = max(st[3], seconds)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Flush the device trace to disk (reference profiler.py:dump). The
+    jax trace is written at stop; dump() stops if still running."""
+    if _state["running"]:
+        set_state("stop")
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate statistics table (reference profiler.py:dumps over
+    aggregate_stats.cc)."""
+    with _lock:
+        lines = [
+            "Profile Statistics (framework dispatch spans; device timing "
+            "is in the trace directory %r)" % _trace_dir(),
+            "%-40s %10s %14s %14s %14s" % ("Name", "Calls", "Total(ms)",
+                                           "Min(ms)", "Max(ms)"),
+        ]
+        for name in sorted(_op_stats):
+            cnt, tot, mn, mx = _op_stats[name]
+            lines.append("%-40s %10d %14.3f %14.3f %14.3f"
+                         % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3))
+        for (dom, name), val in sorted(_counters.items()):
+            lines.append("%-40s %10s %14s" % ("%s::%s" % (dom, name),
+                                              "counter", val))
+        if reset:
+            _op_stats.clear()
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# user-defined profiling objects (reference profiler.py:Domain/Task/...)
+# ---------------------------------------------------------------------------
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+    def __repr__(self):
+        return "Domain('%s')" % self.name
+
+
+class _Span:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        _events.append((self._t0, "start", self._qual(), None))
+
+    def stop(self):
+        t1 = time.perf_counter()
+        _events.append((t1, "stop", self._qual(), None))
+        if self._t0 is not None and is_recording():
+            record_op_span(self._qual(), t1 - self._t0)
+
+    def _qual(self):
+        return "%s::%s" % (self.domain.name, self.name)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Span):
+    pass
+
+
+class Frame(_Span):
+    pass
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        if value is not None:
+            self.set_value(value)
+
+    def _key(self):
+        return (self.domain.name, self.name)
+
+    def set_value(self, value):
+        _counters[self._key()] = value
+
+    def increment(self, delta=1):
+        _counters[self._key()] = _counters.get(self._key(), 0) + delta
+
+    def decrement(self, delta=1):
+        self.increment(-delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        _events.append((time.perf_counter(), "marker",
+                        "%s::%s" % (self.domain.name, self.name), scope))

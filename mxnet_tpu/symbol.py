@@ -143,15 +143,17 @@ _PARAM_SHAPE_RULES = {
     "LinearRegressionOutput": _regression_shapes,
     "LogisticRegressionOutput": _regression_shapes,
     "MAERegressionOutput": _regression_shapes,
+    # int8 variants share their fp32 op's parameter geometry
+    "_contrib_quantized_conv": _conv_shapes,
+    "_contrib_quantized_fully_connected": _fc_shapes,
 }
 
-_UNNAMED_COUNT = {}
-
-
 def _auto_name(hint):
-    cnt = _UNNAMED_COUNT.get(hint, 0)
-    _UNNAMED_COUNT[hint] = cnt + 1
-    return "%s%d" % (hint, cnt)
+    """Auto names route through the NameManager stack so
+    `with mx.name.Prefix('net_'):` scopes compose (reference name.py)."""
+    from .name import current_manager
+
+    return current_manager().get(None, hint)
 
 
 class Symbol:
@@ -634,14 +636,45 @@ def _make_symbol_op(op_name):
         return fn
     op = _registry.get(op_name)
     try:
-        sig_params = [p for p in inspect.signature(op.fn).parameters
-                      if p != "rng_key"]
+        sig = inspect.signature(op.fn)
+        sig_params = [p for p in sig.parameters if p != "rng_key"]
+        has_varargs = any(
+            p.kind == inspect.Parameter.VAR_POSITIONAL
+            for p in sig.parameters.values())
     except (TypeError, ValueError):
         sig_params = []
+        has_varargs = False
     param_inputs = _OP_PARAM_INPUTS.get(op_name, [])
     param_names = {p[0] for p in param_inputs}
 
     def sym_op(*args, name=None, attr=None, **kwargs):
+        if has_varargs:
+            # Variadic op (*arrays, **attrs): every positional Symbol is
+            # an input in order; everything else is an attr.
+            inputs_v = [a for a in args if isinstance(a, Symbol)]
+            if len(inputs_v) != len(args):
+                raise TypeError(
+                    "%s: positional args must all be Symbols; pass "
+                    "scalars by keyword" % op_name)
+            attrs_v = {}
+            for k, v in kwargs.items():
+                if isinstance(v, Symbol):
+                    inputs_v.append(v)
+                elif v is not None:
+                    attrs_v[k] = v
+            attrs_v["_op_name"] = op_name
+            from .attribute import current_attrs
+
+            scoped = current_attrs()
+            if scoped:
+                attrs_v.update({"__%s__" % k: v for k, v in scoped.items()})
+            if attr:
+                attrs_v.update({"__%s__" % k: v for k, v in attr.items()})
+            name_v = name or _auto_name(op_name.lower().lstrip("_"))
+            rule = _NUM_OUTPUT_RULES.get(op_name)
+            n_out_v = rule(attrs_v) if rule else 1
+            return Symbol(op_name, attrs=attrs_v, inputs=inputs_v,
+                          name=name_v, num_outputs=n_out_v)
         inputs = {}
         attrs = {}
         pos = 0

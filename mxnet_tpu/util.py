@@ -1,0 +1,63 @@
+"""Misc utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "get_gpu_count", "get_gpu_memory", "use_np_shape",
+           "is_np_shape", "set_np_shape"]
+
+_np_shape = [True]  # numpy-style zero-size shapes are native on jax
+
+
+def makedirs(d):
+    """mkdir -p (reference util.py:makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_tpus
+
+    return num_tpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    """Per-device memory stats from the PJRT client (free, total) in
+    bytes; (-1, -1) when the backend does not expose them."""
+    import jax
+
+    try:
+        dev = jax.devices()[gpu_dev_id]
+        stats = dev.memory_stats()
+        total = stats.get("bytes_limit", -1)
+        used = stats.get("bytes_in_use", 0)
+        return (total - used if total > 0 else -1, total)
+    except Exception:
+        return (-1, -1)
+
+
+def set_np_shape(active):
+    """Zero-dim/zero-size shape semantics toggle (reference
+    util.py:set_np_shape). XLA shapes are numpy-semantic natively, so
+    this records-and-returns; nothing needs switching."""
+    prev = _np_shape[0]
+    _np_shape[0] = bool(active)
+    return prev
+
+
+def is_np_shape():
+    return _np_shape[0]
+
+
+def use_np_shape(func):
+    """Decorator form (reference util.py:use_np_shape)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        prev = set_np_shape(True)
+        try:
+            return func(*args, **kwargs)
+        finally:
+            set_np_shape(prev)
+
+    return wrapper

@@ -1,0 +1,216 @@
+"""gluon.contrib, mx.operator (CustomOp), mx.rtc (Pallas) — the
+advertised-surface completion batch (reference tests:
+test_gluon_contrib.py, test_operator.py custom-op section, test_rtc.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+# ---------------------------------------------------------------------------
+# gluon.contrib.nn
+# ---------------------------------------------------------------------------
+
+def test_concurrent_and_identity():
+    from mxnet_tpu.gluon.contrib.nn import (HybridConcurrent, Concurrent,
+                                            Identity)
+
+    for cls in (Concurrent, HybridConcurrent):
+        net = cls(axis=-1)
+        net.add(gluon.nn.Dense(4, in_units=3))
+        net.add(Identity())
+        net.add(gluon.nn.Dense(2, in_units=3))
+        net.initialize()
+        x = mx.nd.ones((5, 3))
+        out = net(x)
+        assert out.shape == (5, 4 + 3 + 2)
+
+
+def test_sparse_embedding_and_sync_bn():
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding, SyncBatchNorm
+
+    emb = SparseEmbedding(20, 6)
+    emb.initialize()
+    idx = mx.nd.array(np.array([1, 3, 1], np.float32))
+    out = emb(idx)
+    assert out.shape == (3, 6)
+    assert emb.weight.grad_stype == "row_sparse"
+
+    bn = SyncBatchNorm(in_channels=4, num_devices=8)
+    bn.initialize()
+    y = bn(mx.nd.ones((2, 4, 3, 3)))
+    assert y.shape == (2, 4, 3, 3)
+
+
+# ---------------------------------------------------------------------------
+# gluon.contrib.rnn
+# ---------------------------------------------------------------------------
+
+def test_variational_dropout_cell():
+    from mxnet_tpu.gluon.contrib.rnn import VariationalDropoutCell
+
+    cell = VariationalDropoutCell(gluon.rnn.LSTMCell(8, input_size=6),
+                                  drop_inputs=0.3, drop_states=0.3,
+                                  drop_outputs=0.3)
+    cell.initialize()
+    x = mx.nd.ones((2, 5, 6))          # NTC
+    with autograd.record():            # dropout active in train mode
+        outputs, states = cell.unroll(5, x, merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert len(states) == 2
+    # inference: masks are no-ops
+    outputs2, _ = cell.unroll(5, x, merge_outputs=True)
+    assert np.isfinite(outputs2.asnumpy()).all()
+
+
+def test_lstmp_cell():
+    from mxnet_tpu.gluon.contrib.rnn import LSTMPCell
+
+    cell = LSTMPCell(hidden_size=12, projection_size=5, input_size=4)
+    cell.initialize()
+    x = mx.nd.ones((3, 4))
+    states = cell.begin_state(batch_size=3)
+    out, new_states = cell(x, states)
+    assert out.shape == (3, 5)                 # projected
+    assert new_states[0].shape == (3, 5)       # r
+    assert new_states[1].shape == (3, 12)      # c
+    # unrolls like any cell
+    seq = mx.nd.ones((3, 6, 4))
+    outputs, _ = cell.unroll(6, seq, merge_outputs=True)
+    assert outputs.shape == (3, 6, 5)
+
+
+@pytest.mark.parametrize("dims", [1, 2])
+def test_conv_rnn_cells(dims):
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    spatial = (10,) if dims == 1 else (8, 8)
+    in_shape = (3,) + spatial
+    for name in ("RNN", "LSTM", "GRU"):
+        cls = getattr(crnn, "Conv%dD%sCell" % (dims, name))
+        cell = cls(in_shape, hidden_channels=5, i2h_kernel=3,
+                   h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.nd.ones((2,) + in_shape)
+        states = cell.begin_state(batch_size=2)
+        out, new_states = cell(x, states)
+        assert out.shape == (2, 5) + spatial, (name, out.shape)
+        for s in new_states:
+            assert s.shape == (2, 5) + spatial
+
+
+def test_conv_lstm_unroll_trains():
+    from mxnet_tpu.gluon.contrib.rnn import Conv1DLSTMCell
+
+    cell = Conv1DLSTMCell((2, 6), hidden_channels=3, i2h_kernel=3,
+                          h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    seq = mx.nd.array(np.random.RandomState(0).rand(2, 4, 2, 6)
+                      .astype(np.float32))
+    with autograd.record():
+        outputs, _ = cell.unroll(4, seq, merge_outputs=True)
+        loss = (outputs * outputs).mean()
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert np.isfinite(g.asnumpy()).all() and float(
+        g.abs().sum().asnumpy()) > 0
+
+
+def test_interval_sampler():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    s = list(IntervalSampler(10, 3))
+    assert s == [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    s2 = list(IntervalSampler(10, 3, rollover=False))
+    assert s2 == [0, 3, 6, 9]
+
+
+# ---------------------------------------------------------------------------
+# mx.operator custom ops
+# ---------------------------------------------------------------------------
+
+def _register_sigmoid():
+    _ = mx.operator  # trigger the lazy mx.operator module alias
+
+    class MySigmoid(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            y = 1.0 / (1.0 + (-in_data[0]).exp())
+            self.assign(out_data[0], req[0], y)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    @mx.operator.register("my_sigmoid")
+    class MySigmoidProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return MySigmoid()
+
+    return MySigmoidProp
+
+
+def test_custom_op_forward_backward():
+    _register_sigmoid()
+    x_np = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    x = mx.nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="my_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    expected = 1 / (1 + np.exp(-x_np))
+    np.testing.assert_allclose(y.asnumpy(), expected, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               expected * (1 - expected), rtol=1e-5)
+
+
+def test_custom_op_symbolic():
+    _register_sigmoid()
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data, op_type="my_sigmoid", name="cust")
+    x_np = np.array([[0.0, 1.0]], np.float32)
+    ex = out.bind(mx.cpu(), {"data": mx.nd.array(x_np)},
+                  args_grad={"data": mx.nd.zeros((1, 2))})
+    res = ex.forward(is_train=True)
+    np.testing.assert_allclose(res[0].asnumpy(), 1 / (1 + np.exp(-x_np)),
+                               rtol=1e-5)
+    ex.backward(out_grads=[mx.nd.ones((1, 2))])
+    s = 1 / (1 + np.exp(-x_np))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               s * (1 - s), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# mx.rtc Pallas kernels
+# ---------------------------------------------------------------------------
+
+def test_pallas_kernel_launch():
+    def scale_add(x_ref, y_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0 + y_ref[:]
+
+    mod = mx.rtc.PallasModule(scale_add=scale_add)
+    k = mod.get_kernel("scale_add")
+    a = mx.nd.array(np.arange(8, dtype=np.float32).reshape(1, 8))
+    b = mx.nd.ones((1, 8))
+    out = k.launch([a, b])
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.arange(8).reshape(1, 8) * 2 + 1)
+
+
+def test_cuda_module_raises():
+    with pytest.raises(NotImplementedError):
+        mx.rtc.CudaModule("__global__ void k() {}")

@@ -1,0 +1,102 @@
+"""Profiler, Monitor, visualization, util, name — SURVEY §5.1/§5.5
+subsystems (reference tests: test_profiler.py, monitor usage in
+test_monitor-ish flows)."""
+import glob
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def test_profiler_trace_and_aggregate():
+    with tempfile.TemporaryDirectory() as d:
+        trace_dir = os.path.join(d, "prof")
+        profiler.set_config(filename=trace_dir, aggregate_stats=True)
+        profiler.set_state("run")
+        a = mx.nd.ones((32, 32))
+        for _ in range(3):
+            a = mx.nd.dot(a, a) * 0.01
+        a.wait_to_read()
+        profiler.set_state("stop")
+        stats = profiler.dumps()
+        assert "dot" in stats and "Calls" in stats
+        # device trace written (xplane/tensorboard layout)
+        produced = glob.glob(os.path.join(trace_dir, "**", "*"),
+                             recursive=True)
+        assert produced, "no trace output in %s" % trace_dir
+
+
+def test_profiler_pause_resume():
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    profiler.pause()
+    b = mx.nd.ones((4, 4)).exp()
+    b.wait_to_read()
+    profiler.resume()
+    c = mx.nd.ones((4, 4)).tanh()
+    c.wait_to_read()
+    profiler.set_state("stop")
+    stats = profiler.dumps(reset=True)
+    assert "tanh" in stats
+    assert "exp" not in stats
+
+
+def test_profiler_domains_counters():
+    dom = profiler.Domain("test_domain")
+    counter = dom.new_counter("ops_done", 0)
+    counter.increment(5)
+    task = dom.new_task("phase1")
+    profiler.set_state("run")
+    with task:
+        mx.nd.ones((2, 2)).sum().wait_to_read()
+    profiler.set_state("stop")
+    stats = profiler.dumps()
+    assert "test_domain::ops_done" in stats
+
+
+def test_monitor_collects_stats():
+    from mxnet_tpu.monitor import Monitor
+
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    out = mx.sym.softmax(fc, name="sm")
+    ex = out.bind(mx.cpu(), {"data": mx.nd.ones((2, 3)),
+                             "fc1_weight": mx.nd.ones((4, 3)),
+                             "fc1_bias": mx.nd.zeros((4,))})
+    mon = Monitor(interval=1, pattern=".*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward()
+    res = mon.toc()
+    assert res, "monitor collected nothing"
+    names = [r[1] for r in res]
+    assert any("output" in n for n in names)
+
+
+def test_print_summary_and_plot(capsys):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    total = mx.viz.print_summary(net, shape={"data": (1, 16)})
+    cap = capsys.readouterr().out
+    assert "fc1" in cap and "Total params" in cap
+    # 16*8+8 + 8*2+2 = 154
+    assert total == 154
+    dot = mx.viz.plot_network(net)
+    src = dot if isinstance(dot, str) else dot.source
+    assert "fc1" in src and "->" in src
+
+
+def test_util_and_name():
+    from mxnet_tpu import util
+
+    assert util.get_gpu_count() >= 0
+    with mx.name.Prefix("scope_"):
+        s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2)
+        assert s.name.startswith("scope_")
+    s2 = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2)
+    assert not s2.name.startswith("scope_")

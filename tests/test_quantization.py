@@ -1,0 +1,96 @@
+"""INT8 quantization: ops + calibration driver (reference
+tests/python/quantization/test_quantization.py; acceptance: quantized
+LeNet within 1% of fp32 accuracy on synthetic MNIST)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.nd.array(np.linspace(-3, 5, 64, dtype=np.float32).reshape(8, 8))
+    q, mn, mx_ = mx.nd._contrib_quantize(x, mx.nd.array([-3.0]),
+                                         mx.nd.array([5.0]))
+    assert str(q.dtype) == "int8"
+    back = mx.nd._contrib_dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=5.0 / 127)
+
+
+def test_quantize_v2_auto_range():
+    x = mx.nd.array(np.array([[-1.0, 0.5, 2.0]], np.float32))
+    q, mn, mx_ = mx.nd._contrib_quantize_v2(x)
+    assert float(mn.asnumpy()) == -1.0 and float(mx_.asnumpy()) == 2.0
+    back = mx.nd._contrib_dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=2.0 / 127)
+
+
+def test_optimal_threshold_reasonable():
+    from mxnet_tpu.contrib.quantization import _get_optimal_threshold
+
+    rng = np.random.RandomState(0)
+    arr = np.concatenate([rng.randn(100000), np.array([50.0])])  # outlier
+    lo, hi = _get_optimal_threshold(arr)
+    # KL calibration should clip far below the outlier
+    assert hi < 25.0 and hi > 1.0
+
+
+def _make_lenet_data():
+    """Synthetic MNIST-like: class k puts a bright patch in quadrant k."""
+    rng = np.random.RandomState(42)
+    n = 400
+    X = (rng.rand(n, 1, 12, 12) * 0.3).astype(np.float32)
+    y = rng.randint(0, 4, n).astype(np.float32)
+    quads = [(slice(0, 6), slice(0, 6)), (slice(0, 6), slice(6, 12)),
+             (slice(6, 12), slice(0, 6)), (slice(6, 12), slice(6, 12))]
+    for i in range(n):
+        r, c = quads[int(y[i])]
+        X[i, 0, r, c] += 1.0
+    return X, y
+
+
+def _lenet_sym():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f1 = mx.sym.FullyConnected(p1, num_hidden=32, name="f1")
+    a2 = mx.sym.Activation(f1, act_type="relu")
+    f2 = mx.sym.FullyConnected(a2, num_hidden=4, name="f2")
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def test_quantized_lenet_accuracy():
+    X, y = _make_lenet_data()
+    train_iter = mio.NDArrayIter(X[:300], y[:300], batch_size=50,
+                                 shuffle=True, label_name="softmax_label")
+    test_iter = mio.NDArrayIter(X[300:], y[300:], batch_size=50,
+                                label_name="softmax_label")
+    mod = mx.mod.Module(_lenet_sym(), context=mx.cpu(),
+                        label_names=["softmax_label"])
+    mod.fit(train_iter, num_epoch=10, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    fp32_acc = mod.score(test_iter, mx.metric.Accuracy())[0][1]
+    assert fp32_acc > 0.7, "fp32 LeNet failed to train (acc %.3f)" % fp32_acc
+
+    arg_params, aux_params = mod.get_params()
+    calib_iter = mio.NDArrayIter(X[:100], y[:100], batch_size=50,
+                                 label_name="softmax_label")
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    qsym, qargs, qaux = quantize_model(
+        mod.symbol, arg_params, aux_params, calib_mode="naive",
+        calib_data=calib_iter, num_calib_examples=100)
+    # int8 weights really are int8
+    assert any(str(v.dtype) == "int8" for v in qargs.values())
+
+    qmod = mx.mod.Module(qsym, context=mx.cpu(),
+                         label_names=["softmax_label"])
+    test_iter.reset()
+    qmod.bind(data_shapes=test_iter.provide_data,
+              label_shapes=test_iter.provide_label, for_training=False)
+    qmod.set_params(qargs, qaux, allow_missing=False)
+    test_iter.reset()
+    q_acc = qmod.score(test_iter, mx.metric.Accuracy())[0][1]
+    assert abs(fp32_acc - q_acc) <= 0.01 + 1e-9, \
+        "quantized accuracy %.3f vs fp32 %.3f" % (q_acc, fp32_acc)
