@@ -1,0 +1,477 @@
+"""Detection / bounding-box operators (SSD & R-CNN families).
+
+Reference: src/operator/contrib/multibox_prior.cc, multibox_target.cc,
+multibox_detection.cc (SSD pipeline), bounding_box.cc (box_nms/box_iou/
+bipartite_matching), src/operator/roi_pooling.cc, contrib/roi_align.cc.
+
+TPU rebuild: everything is fixed-shape, mask-based dataflow — no
+dynamic-size outputs. Matching and NMS are expressed as `lax.fori_loop`s
+over score-sorted candidates carrying suppression masks (the reference
+mutates workspaces with dynamic loops; masked fixed-trip loops are the
+XLA-legal equivalent with identical results), and invalid slots hold -1
+exactly like the reference's outputs. Boxes are corner-format
+(xmin, ymin, xmax, ymax) in relative coordinates.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _pairwise_iou(jnp, a, b):
+    """IoU matrix between corner boxes a (..., N, 4) and b (..., M, 4)."""
+    ax1, ay1, ax2, ay2 = [a[..., :, None, i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., None, :, i] for i in range(4)]
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+    area_b = jnp.maximum(bx2 - bx1, 0) * jnp.maximum(by2 - by1, 0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# multibox_prior
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior",
+          aliases=("_contrib_multibox_prior", "MultiBoxPrior"),
+          differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes per feature-map pixel (reference multibox_prior.cc).
+    num_anchors = len(sizes) + len(ratios) - 1: (s_i, r_0) for every
+    size plus (s_0, r_j) for the extra ratios. Output (1, H*W*A, 4)."""
+    jnp = _jnp()
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(float(s) for s in (sizes if isinstance(sizes, (list, tuple))
+                                     else (sizes,)))
+    ratios = tuple(float(r) for r in (ratios if isinstance(ratios,
+                                                           (list, tuple))
+                                      else (ratios,)))
+    # steps/offsets are (y, x) — reference multibox_prior.cc param doc.
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cxg, cyg = jnp.meshgrid(cx, cy)          # (h, w)
+
+    ws, hs = [], []
+    for s in sizes:
+        ws.append(s * np.sqrt(ratios[0]))
+        hs.append(s / np.sqrt(ratios[0]))
+    for r in ratios[1:]:
+        ws.append(sizes[0] * np.sqrt(r))
+        hs.append(sizes[0] / np.sqrt(r))
+    ws = jnp.asarray(ws, jnp.float32) / 2    # half extents
+    hs = jnp.asarray(hs, jnp.float32) / 2
+
+    cxg = cxg[..., None]                     # (h, w, 1)
+    cyg = cyg[..., None]
+    boxes = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+# ---------------------------------------------------------------------------
+# bipartite matching + target assignment
+# ---------------------------------------------------------------------------
+
+def _greedy_bipartite(jnp, lax, score, valid_col, max_matches=None):
+    """Greedy bipartite match on score (N, M): repeatedly take the global
+    max, assign, and knock out that row+column (reference
+    bounding_box.cc:BipartiteMatching). Returns row->col (-1 unmatched).
+    `valid_col` masks padded ground-truths; `max_matches` caps the number
+    of greedy rounds (the reference's topk)."""
+    n, m = score.shape
+    neg = jnp.float32(-1e30)
+    score = jnp.where(valid_col[None, :], score, neg)
+    rounds = min(n, m)
+    if max_matches is not None and max_matches >= 0:
+        rounds = min(rounds, int(max_matches))
+
+    def body(_, carry):
+        s, row_match = carry
+        idx = jnp.argmax(s)
+        r, c = idx // m, idx % m
+        ok = s[r, c] > 0
+        row_match = jnp.where(ok, row_match.at[r].set(c), row_match)
+        s = jnp.where(ok, s.at[r, :].set(neg).at[:, c].set(neg), s)
+        return s, row_match
+
+    _, row_match = lax.fori_loop(
+        0, rounds, body, (score, jnp.full((n,), -1, jnp.int32)))
+    return row_match
+
+
+@register("_contrib_bipartite_matching", differentiable=False)
+def _bipartite_matching(dist, is_ascend=False, threshold=1e-12, topk=-1):
+    """(reference bounding_box.cc:_contrib_bipartite_matching). Returns
+    (row->col, col->row) assignments, -1 for unmatched."""
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    d = dist
+    if is_ascend:
+        d = -d
+        threshold = -threshold
+
+    def one(dm):
+        n, m = dm.shape
+        shifted = dm - jnp.float32(threshold) + 1e-12
+        row = _greedy_bipartite(jnp, lax, shifted,
+                                jnp.ones((m,), bool), max_matches=topk)
+        # Scatter only matched rows (unmatched go to an out-of-bounds
+        # slot and are dropped — a -1 fill index would clobber col[0]).
+        col = jnp.full((m,), -1, jnp.int32)
+        col = col.at[jnp.where(row >= 0, row, m)].set(
+            jnp.arange(n, dtype=jnp.int32), mode="drop")
+        return row.astype(jnp.float32), col.astype(jnp.float32)
+
+    if dist.ndim == 2:
+        return one(d)
+    rows, cols = jax.vmap(one)(d)
+    return rows, cols
+
+
+@register("_contrib_MultiBoxTarget",
+          aliases=("_contrib_multibox_target", "MultiBoxTarget"),
+          differentiable=False)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference multibox_target.cc).
+
+    anchor (1, A, 4); label (B, M, 5) rows [cls, x1, y1, x2, y2], padded
+    with -1; cls_pred (B, C+1, A) (used for hard negative mining order).
+    Returns (box_target (B, A*4), box_mask (B, A*4), cls_target (B, A)).
+    """
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    anchors = anchor[0]                      # (A, 4)
+    a_num = anchors.shape[0]
+    v0, v1, v2, v3 = [float(v) for v in variances]
+
+    def per_sample(lab, cpred):
+        valid = lab[:, 0] >= 0               # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = _pairwise_iou(jnp, anchors, gt_boxes)     # (A, M)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        # Stage 1 — bipartite: every gt grabs its best anchor.
+        anchor_gt = _greedy_bipartite(jnp, lax, iou, valid)   # (A,) -> gt
+        matched = anchor_gt >= 0
+        # Stage 2 — threshold: remaining anchors take their argmax gt if
+        # IoU clears overlap_threshold.
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        stage2 = (~matched) & (best_iou >= overlap_threshold)
+        anchor_gt = jnp.where(stage2, best_gt, anchor_gt)
+        matched = anchor_gt >= 0
+        gt_idx = jnp.where(matched, anchor_gt, 0)
+
+        # Class targets: matched -> gt class + 1; negatives -> 0; with
+        # hard negative mining, surplus negatives -> ignore_label.
+        cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # Hard negatives come only from anchors whose best overlap is
+            # below negative_mining_thresh (reference multibox_target.cc);
+            # "hardness" = max non-background prob of the prediction.
+            mineable = (~matched) & (best_iou < negative_mining_thresh)
+            neg_score = jnp.max(cpred[1:, :], axis=0)    # (A,)
+            neg_score = jnp.where(mineable, neg_score, -jnp.inf)
+            num_pos = jnp.sum(matched)
+            quota = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                int(minimum_negative_samples))
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros((a_num,), jnp.int32).at[order].set(
+                jnp.arange(a_num, dtype=jnp.int32))
+            keep_neg = mineable & (rank < quota)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(keep_neg, 0.0,
+                                        jnp.float32(ignore_label)))
+
+        # Box targets: encoded offsets of the matched gt.
+        g = gt_boxes[gt_idx]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-8)
+        ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-8)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+        t = jnp.stack([(gcx - acx) / aw / v0, (gcy - acy) / ah / v1,
+                       jnp.log(gw / aw) / v2, jnp.log(gh / ah) / v3],
+                      axis=1)                            # (A, 4)
+        mask = matched[:, None].astype(jnp.float32)
+        box_t = (t * mask).reshape(-1)
+        box_m = jnp.tile(mask, (1, 4)).reshape(-1)
+        return box_t, box_m, cls_t
+
+    box_target, box_mask, cls_target = jax.vmap(per_sample)(label, cls_pred)
+    return box_target, box_mask, cls_target
+
+
+# ---------------------------------------------------------------------------
+# NMS + detection decode
+# ---------------------------------------------------------------------------
+
+def _nms_mask(jnp, lax, boxes, scores, cls_ids, valid, thresh,
+              force_suppress, topk):
+    """Greedy NMS keep-mask over score-sorted candidates (reference
+    bounding_box.cc:NMSApply as a masked fixed-trip loop)."""
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    c = cls_ids[order]
+    v = valid[order]
+    iou = _pairwise_iou(jnp, b, b)
+    same_cls = (c[:, None] == c[None, :]) | bool(force_suppress)
+    limit = n if topk is None or topk < 0 else min(int(topk), n)
+
+    def body(i, keep):
+        active = keep[i] & v[i] & (i < limit)
+        kill = active & (iou[i] > thresh) & same_cls[i] & \
+            (jnp.arange(n) > i)
+        return keep & ~kill
+
+    keep = lax.fori_loop(0, n, body, v)
+    if topk is not None and topk >= 0:
+        keep = keep & (jnp.arange(n) < limit)
+    # unsort back to original order
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    return keep[inv]
+
+
+@register("_contrib_box_nms",
+          aliases=("_contrib_box_non_maximum_suppression", "box_nms"),
+          differentiable=False)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1,
+             force_suppress=False, in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference bounding_box.cc:box_nms).
+    data (..., N, K): suppressed entries become all -1; surviving rows'
+    coordinates are rewritten to `out_format`."""
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+
+    def one(d):
+        scores = d[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(d, coord_start, 4, axis=1)
+        if in_format == "center":
+            cx, cy, w, h = [boxes[:, i] for i in range(4)]
+            corners = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                                 cy + h / 2], axis=1)
+        else:
+            corners = boxes
+        ids = d[:, id_index] if id_index >= 0 else jnp.zeros_like(scores)
+        valid = scores > valid_thresh
+        keep = _nms_mask(jnp, lax, corners, scores, ids, valid,
+                         overlap_thresh, force_suppress or id_index < 0,
+                         topk)
+        if out_format != in_format:
+            if out_format == "corner":
+                conv = corners
+            else:
+                x1, y1, x2, y2 = [corners[:, i] for i in range(4)]
+                conv = jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2,
+                                  x2 - x1, y2 - y1], axis=1)
+            d = lax.dynamic_update_slice_in_dim(d, conv, coord_start,
+                                                axis=1)
+        return jnp.where(keep[:, None], d, -jnp.ones_like(d))
+
+    if data.ndim == 2:
+        return one(data)
+    flat = data.reshape((-1,) + data.shape[-2:])
+    out = jax.vmap(one)(flat)
+    return out.reshape(data.shape)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",), differentiable=False)
+def _box_iou(lhs, rhs, format="corner"):
+    """(reference bounding_box.cc:_contrib_box_iou)."""
+    jnp = _jnp()
+    if format == "center":
+        def to_corner(b):
+            cx, cy, w, h = [b[..., i] for i in range(4)]
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                              cy + h / 2], axis=-1)
+
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    return _pairwise_iou(jnp, lhs, rhs)
+
+
+@register("_contrib_MultiBoxDetection",
+          aliases=("_contrib_multibox_detection", "MultiBoxDetection"),
+          differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD inference decode + per-class NMS (reference
+    multibox_detection.cc). cls_prob (B, C+1, A), loc_pred (B, A*4),
+    anchor (1, A, 4) -> (B, A, 6) rows [cls_id, score, x1, y1, x2, y2],
+    suppressed/background rows -1."""
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    anchors = anchor[0]
+    a_num = anchors.shape[0]
+    v0, v1, v2, v3 = [float(v) for v in variances]
+
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-8)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-8)
+
+    def per_sample(cp, lp):
+        lp = lp.reshape(a_num, 4)
+        cx = lp[:, 0] * v0 * aw + acx
+        cy = lp[:, 1] * v1 * ah + acy
+        w = jnp.exp(lp[:, 2] * v2) * aw / 2
+        h = jnp.exp(lp[:, 3] * v3) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # Best non-background class per anchor; reported ids are 0-based
+        # over foreground classes (reference: class k of the C+1 softmax
+        # reports as k-1, background suppressed).
+        fg = jnp.concatenate([cp[:int(background_id)],
+                              cp[int(background_id) + 1:]], axis=0)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        valid = score > threshold
+        keep = _nms_mask(jnp, lax, boxes, score, cls_id, valid,
+                         nms_threshold, force_suppress, nms_topk)
+        out = jnp.concatenate([cls_id[:, None], score[:, None], boxes],
+                              axis=1)
+        return jnp.where(keep[:, None], out, -jnp.ones_like(out))
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling / align
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Max-pool each ROI into a fixed grid (reference roi_pooling.cc).
+    data (N, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coords; output (R, C, ph, pw). Bin membership is mask-based —
+    fixed shapes, XLA-friendly; identical integer bin rounding to the
+    reference (floor/ceil of scaled coords)."""
+    import jax
+
+    jnp = _jnp()
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    n, c, h, w = data.shape
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bidx]                     # (C, H, W)
+        i = jnp.arange(ph, dtype=jnp.float32)
+        j = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.floor(y1 + i * bin_h)
+        hend = jnp.ceil(y1 + (i + 1) * bin_h)
+        wstart = jnp.floor(x1 + j * bin_w)
+        wend = jnp.ceil(x1 + (j + 1) * bin_w)
+        ymask = (ys[None, :] >= hstart[:, None]) & \
+            (ys[None, :] < hend[:, None])                   # (ph, H)
+        xmask = (xs[None, :] >= wstart[:, None]) & \
+            (xs[None, :] < wend[:, None])                   # (pw, W)
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]  # ph pw H W
+        vals = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(3, 4))                     # (C, ph, pw)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_ROIAlign", aliases=("_contrib_roi_align",))
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sample_ratio=2):
+    """Average of bilinear samples per bin (reference contrib
+    roi_align.cc; Mask R-CNN ROIAlign — no coordinate rounding).
+    sample_ratio <= 0 means adaptive in the reference (per-ROI
+    ceil(bin size)); XLA needs a static count, so adaptive mode uses the
+    feature-map-level bound ceil(map/pooled) — exact for full-map ROIs,
+    an over-sampling (never coarser) elsewhere."""
+    import jax
+
+    jnp = _jnp()
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    n, c, h, w = data.shape
+    if int(sample_ratio) > 0:
+        s = int(sample_ratio)
+    else:
+        s = max(1, int(np.ceil(max(h / ph, w / pw))))
+
+    def bilinear(img, y, x):
+        y0 = jnp.clip(jnp.floor(y), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(x), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        ly = jnp.clip(y - y0, 0, 1)
+        lx = jnp.clip(x - x0, 0, 1)
+        y0i, x0i, y1i, x1i = [a.astype(jnp.int32) for a in (y0, x0, y1, x1)]
+        v = (img[:, y0i, x0i] * (1 - ly) * (1 - lx)
+             + img[:, y1i, x0i] * ly * (1 - lx)
+             + img[:, y0i, x1i] * (1 - ly) * lx
+             + img[:, y1i, x1i] * ly * lx)
+        return v
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        x2 = roi[3] * spatial_scale
+        y2 = roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bidx]
+        i = jnp.arange(ph, dtype=jnp.float32)[:, None, None, None]
+        j = jnp.arange(pw, dtype=jnp.float32)[None, :, None, None]
+        si = (jnp.arange(s, dtype=jnp.float32) + 0.5)[None, None, :, None]
+        sj = (jnp.arange(s, dtype=jnp.float32) + 0.5)[None, None, None, :]
+        ys_ = y1 + i * bin_h + si * bin_h / s   # sample centers
+        xs_ = x1 + j * bin_w + sj * bin_w / s
+        ys_b = jnp.broadcast_to(ys_, (ph, pw, s, s)).reshape(-1)
+        xs_b = jnp.broadcast_to(xs_, (ph, pw, s, s)).reshape(-1)
+        vals = bilinear(img, ys_b, xs_b)        # (C, ph*pw*s*s)
+        vals = vals.reshape(c, ph, pw, s * s)
+        return jnp.mean(vals, axis=-1)
+
+    return jax.vmap(one)(rois)

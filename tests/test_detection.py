@@ -1,0 +1,263 @@
+"""Detection op family + SSD end-to-end (reference:
+tests/python/unittest/test_contrib_operator.py multibox/box_nms cases;
+north-star tracked config SSD-VGG16 — here a tiny SSD on synthetic
+data, converging and detecting)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def test_multibox_prior_shapes_and_values():
+    data = mx.nd.zeros((1, 3, 4, 4))
+    anchors = mx.nd.contrib.MultiBoxPrior(data, sizes=(0.5, 0.25),
+                                          ratios=(1.0, 2.0))
+    # A = len(sizes) + len(ratios) - 1 = 3 per pixel
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor at pixel (0,0): center (0.125, 0.125), size 0.5
+    np.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                      0.125 + 0.25, 0.125 + 0.25],
+                               atol=1e-6)
+    # ratio-2 anchor is wider than tall
+    third = a[2]
+    assert (third[2] - third[0]) > (third[3] - third[1])
+
+
+def test_box_iou():
+    a = mx.nd.array([[0, 0, 2, 2]], dtype="float32")
+    b = mx.nd.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]],
+                    dtype="float32")
+    iou = mx.nd.contrib.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+
+def test_box_nms():
+    # rows: [cls_id, score, x1, y1, x2, y2]
+    boxes = mx.nd.array([
+        [0, 0.9, 0.0, 0.0, 0.5, 0.5],
+        [0, 0.8, 0.01, 0.01, 0.5, 0.5],   # overlaps #0 -> suppressed
+        [0, 0.7, 0.6, 0.6, 0.9, 0.9],     # disjoint -> kept
+        [1, 0.6, 0.0, 0.0, 0.5, 0.5],     # other class -> kept
+    ], dtype="float32")
+    out = mx.nd.contrib.box_nms(boxes, overlap_thresh=0.5, coord_start=2,
+                                score_index=1, id_index=0).asnumpy()
+    assert out[0][1] == pytest.approx(0.9)
+    assert np.all(out[1] == -1)
+    assert out[2][1] == pytest.approx(0.7)
+    assert out[3][1] == pytest.approx(0.6)
+    # force_suppress kills cross-class overlap too
+    out2 = mx.nd.contrib.box_nms(boxes, overlap_thresh=0.5, coord_start=2,
+                                 score_index=1, id_index=0,
+                                 force_suppress=True).asnumpy()
+    assert np.all(out2[3] == -1)
+
+
+def test_bipartite_matching():
+    dist = mx.nd.array([[0.5, 0.9], [0.1, 0.2], [0.0, 0.65]])
+    row, col = mx.nd.contrib.bipartite_matching(dist, threshold=1e-12)
+    r = row.asnumpy()
+    # greedy: (0,1)=0.9 first, then (1,0)=0.1? no: next best among
+    # remaining rows/cols is (2,0)=0.0 vs (1,0)=0.1 -> row1-col0
+    assert r[0] == 1 and r[1] == 0 and r[2] == -1
+
+
+def test_multibox_target_assigns():
+    anchors = mx.nd.array(np.array(
+        [[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0],
+          [0.0, 0.5, 0.5, 1.0]]], np.float32))
+    # one gt box matching anchor 0 closely
+    label = mx.nd.array(np.array(
+        [[[1.0, 0.05, 0.05, 0.45, 0.45]]], np.float32))
+    cls_pred = mx.nd.zeros((1, 3, 3))
+    box_t, box_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0          # class 1 -> target 2 (bg=0 offset)
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    bm = box_m.asnumpy()[0].reshape(3, 4)
+    assert bm[0].all() and not bm[1].any()
+    # encoded offsets decode back to the gt box
+    bt = box_t.asnumpy()[0].reshape(3, 4)[0]
+    acx, acy, aw, ah = 0.25, 0.25, 0.5, 0.5
+    gcx = acx + bt[0] * 0.1 * aw
+    gcy = acy + bt[1] * 0.1 * ah
+    gw = aw * np.exp(bt[2] * 0.2)
+    gh = ah * np.exp(bt[3] * 0.2)
+    np.testing.assert_allclose([gcx - gw / 2, gcy - gh / 2,
+                                gcx + gw / 2, gcy + gh / 2],
+                               [0.05, 0.05, 0.45, 0.45], atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = mx.nd.array(np.random.RandomState(0)
+                          .rand(1, 20, 4).astype(np.float32))
+    label = mx.nd.array(np.array([[[0.0, 0.1, 0.1, 0.4, 0.4]]], np.float32))
+    cls_pred = mx.nd.array(np.random.RandomState(1)
+                           .rand(1, 3, 20).astype(np.float32))
+    _, _, cls_t = mx.nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5,
+        negative_mining_ratio=3.0, minimum_negative_samples=1)
+    ct = cls_t.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    n_neg = (ct == 0).sum()
+    n_ign = (ct == -1).sum()
+    assert n_ign > 0 and n_neg <= max(3 * n_pos, 1)
+
+
+def test_roi_pooling_and_align():
+    data = mx.nd.array(np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4))
+    rois = mx.nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    out = mx.nd.ROIPooling(data, rois, pooled_size=(2, 2),
+                           spatial_scale=1.0)
+    assert out.shape == (1, 2, 2, 2)
+    # channel 0 is arange(16) over 4x4: max of each 2x2 quadrant
+    np.testing.assert_allclose(out.asnumpy()[0, 0],
+                               [[5, 7], [13, 15]])
+    al = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                spatial_scale=1.0, sample_ratio=2)
+    assert al.shape == (1, 2, 2, 2)
+    assert np.isfinite(al.asnumpy()).all()
+
+
+def test_roi_pooling_gradient():
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(1, 1, 6, 6).astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 0, 0, 5, 5]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = mx.nd.ROIPooling(x, rois, pooled_size=(2, 2),
+                               spatial_scale=1.0)
+        loss = out.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    # max-pool gradient: exactly one 1 per output bin
+    assert g.sum() == pytest.approx(4.0)
+
+
+# ---------------------------------------------------------------------------
+# tiny SSD end-to-end
+# ---------------------------------------------------------------------------
+
+class TinySSD(gluon.HybridBlock):
+    """One-scale SSD head on a small conv trunk (the SSD-VGG16 recipe at
+    toy size: trunk -> per-anchor class logits + box offsets)."""
+
+    def __init__(self, num_classes=1, num_anchors=3, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.num_anchors = num_anchors
+        self.trunk = gluon.nn.HybridSequential()
+        self.trunk.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+                       gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+                       gluon.nn.MaxPool2D(2))
+        self.register_child(self.trunk)
+        self.cls_head = gluon.nn.Conv2D(num_anchors * (num_classes + 1), 3,
+                                        padding=1)
+        self.loc_head = gluon.nn.Conv2D(num_anchors * 4, 3, padding=1)
+        self.register_child(self.cls_head)
+        self.register_child(self.loc_head)
+
+    def hybrid_forward(self, F, x):
+        feat = self.trunk(x)
+        cls = self.cls_head(feat)          # (B, A*(C+1), h, w)
+        loc = self.loc_head(feat)          # (B, A*4, h, w)
+        b = cls.shape[0]
+        cls = cls.transpose((0, 2, 3, 1)).reshape(
+            (b, -1, self.num_classes + 1))           # (B, hw*A, C+1)
+        loc = loc.transpose((0, 2, 3, 1)).reshape((b, -1))
+        anchors = F.contrib.MultiBoxPrior(
+            feat, sizes=(0.4, 0.6), ratios=(1.0, 2.0))
+        return anchors, cls, loc
+
+
+def _make_ssd_data(n, rng):
+    """Images with one bright square; label = its box, class 0."""
+    X = (rng.rand(n, 1, 16, 16) * 0.2).astype(np.float32)
+    labels = np.zeros((n, 1, 5), np.float32)
+    for i in range(n):
+        size = rng.randint(5, 9)
+        r = rng.randint(0, 16 - size)
+        c = rng.randint(0, 16 - size)
+        X[i, 0, r:r + size, c:c + size] += 1.0
+        labels[i, 0] = [0, c / 16, r / 16, (c + size) / 16, (r + size) / 16]
+    return X, labels
+
+
+def test_ssd_converges_and_detects():
+    rng = np.random.RandomState(0)
+    X, Y = _make_ssd_data(64, rng)
+    net = TinySSD()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.005})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(X)
+    y = mx.nd.array(Y)
+    first = last = None
+    for it in range(60):
+        with autograd.record():
+            anchors, cls, loc = net(x)
+            # targets computed outside the grad graph
+            with autograd.pause():
+                box_t, box_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+                    anchors, y, cls.transpose((0, 2, 1)),
+                    overlap_threshold=0.5)
+            cls_loss = ce(cls.reshape((-1, 2)), cls_t.reshape((-1,)))
+            diff = (loc - box_t) * box_m
+            adiff = diff.abs()
+            loc_loss = mx.nd.where(
+                adiff > 1.0, adiff - 0.5, 0.5 * adiff * adiff).mean()
+            loss = cls_loss.mean() + loc_loss
+        loss.backward()
+        trainer.step(x.shape[0])
+        last = float(loss.asnumpy())
+        if first is None:
+            first = last
+    assert last < first * 0.5, "SSD loss %.4f -> %.4f" % (first, last)
+
+    # detection: decoded top box overlaps ground truth
+    anchors, cls, loc = net(x[:4])
+    cls_prob = cls.softmax(axis=-1).transpose((0, 2, 1))
+    det = mx.nd.contrib.MultiBoxDetection(cls_prob, loc, anchors,
+                                          nms_threshold=0.45,
+                                          threshold=0.01)
+    det_np = det.asnumpy()
+    hits = 0
+    for i in range(4):
+        rows = det_np[i]
+        rows = rows[rows[:, 0] >= 0]
+        assert len(rows), "no detections for sample %d" % i
+        best = rows[np.argmax(rows[:, 1])]
+        gt = Y[i, 0, 1:]
+        x1, y1 = np.maximum(best[2:4], gt[:2])
+        x2, y2 = np.minimum(best[4:6], gt[2:])
+        inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+        area = ((best[4] - best[2]) * (best[5] - best[3])
+                + (gt[2] - gt[0]) * (gt[3] - gt[1]) - inter)
+        if inter / max(area, 1e-8) > 0.3:
+            hits += 1
+    assert hits >= 3, "only %d/4 detections overlap ground truth" % hits
+
+
+def test_bipartite_matching_col_output():
+    """col->row must keep real matches when other rows are unmatched
+    (duplicate-scatter regression)."""
+    dist = mx.nd.array([[0.9], [0.5]])
+    row, col = mx.nd.contrib.bipartite_matching(dist, threshold=1e-12)
+    assert row.asnumpy().tolist() == [0.0, -1.0]
+    assert col.asnumpy().tolist() == [0.0]
+    # topk caps greedy rounds
+    dist2 = mx.nd.array(np.eye(4, dtype=np.float32))
+    row2, _ = mx.nd.contrib.bipartite_matching(dist2, threshold=1e-12,
+                                               topk=2)
+    assert (row2.asnumpy() >= 0).sum() == 2
+
+
+def test_box_nms_format_conversion():
+    boxes = mx.nd.array([[0.9, 0.5, 0.5, 0.2, 0.2]])  # score, cx cy w h
+    out = mx.nd.contrib.box_nms(boxes, coord_start=1, score_index=0,
+                                in_format="center", out_format="corner")
+    np.testing.assert_allclose(out.asnumpy()[0],
+                               [0.9, 0.4, 0.4, 0.6, 0.6], atol=1e-6)
