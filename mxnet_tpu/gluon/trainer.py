@@ -184,6 +184,15 @@ class Trainer:
             if len(grads) > 1 and self._kvstore is None:
                 for g in grads[1:]:
                     grad = grad + g.as_in_context(grad.context)
+            if p.grad_stype == "row_sparse":
+                # Embedding-style gradients touch few rows: convert the
+                # (dense, mostly-zero) autograd gradient to row_sparse so
+                # the optimizer's lazy sparse update path runs (reference
+                # grad_stype='row_sparse' Parameter contract).
+                from ..ndarray import sparse as _sp
+
+                grad = _sp.row_sparse_array(grad.asnumpy(),
+                                            ctx=grad.context)
             self._updater(i, grad, datas[0])
             for d in datas[1:]:
                 d[:] = datas[0].as_in_context(d.context)

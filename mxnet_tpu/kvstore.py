@@ -154,7 +154,16 @@ class KVStoreLocal(KVStore):
     def _merge(self, vlist):
         """Sum per-device values for one key. The jitted add chain lets
         XLA schedule device-to-device moves; with a sharded global array
-        this is a true ICI all-reduce (parallel/ path)."""
+        this is a true ICI all-reduce (parallel/ path). row_sparse values
+        merge by row concatenation + duplicate aggregation without
+        densifying (reference comm.h sparse Reduce)."""
+        if isinstance(vlist[0], _sparse.RowSparseNDArray):
+            import numpy as _np
+
+            idx = _np.concatenate([v.indices.asnumpy() for v in vlist])
+            vals = _np.concatenate([v.data.asnumpy() for v in vlist])
+            return _sparse._aggregate_rsp(vals, idx, vlist[0].shape,
+                                          ctx=vlist[0].context)
         merged = vlist[0]
         for v in vlist[1:]:
             merged = merged + v.as_in_context(merged.context)
@@ -199,18 +208,22 @@ class KVStoreLocal(KVStore):
             row_ids, NDArray) else [[row_ids]] * len(keys)
         for k, olist, rlist in zip(keys, outs, rows):
             stored = self._store[k]
-            if isinstance(stored, _sparse.RowSparseNDArray):
-                stored = stored.todense()
             for o, r in zip(olist, rlist * len(olist) if len(rlist) == 1 else rlist):
-                rows_v = stored.take(r)
+                if isinstance(stored, _sparse.RowSparseNDArray):
+                    # Gather only the requested rows — no densification
+                    # (reference kvstore.h:209 PullRowSparse; the
+                    # bandwidth contract of the API).
+                    rows_v = _sparse._gather_rows(stored, r.asnumpy())
+                else:
+                    rows_v = stored.take(r)
                 if isinstance(o, _sparse.RowSparseNDArray):
                     o._data = rows_v.as_in_context(o.context)._data
                     o._indices = r.as_in_context(o.context)
+                    # keep the logical shape consistent with the store
+                    o._full_shape = tuple(stored.shape)
                 elif o.shape == stored.shape:
-                    # Dense out of full shape: fill selected rows in place
-                    # (other rows keep their current values, matching the
-                    # reference's sparse-to-dense pull behavior).
-                    o[:] = stored.as_in_context(o.context)
+                    # Full-shape dense out: refresh the pulled rows only.
+                    o[r] = rows_v.as_in_context(o.context)
                 else:
                     o[:] = rows_v.as_in_context(o.context)
 

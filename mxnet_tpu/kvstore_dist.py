@@ -222,6 +222,7 @@ class KVStoreDist(KVStoreLocal):
 
                     o._data = _nd_array(vals, ctx=o.context)._data
                     o._indices = _nd_array(r_np, ctx=o.context, dtype="int64")
+                    o._full_shape = tuple(shape)
                 elif o.shape == shape:
                     # Full-shape dense out: only the pulled rows are
                     # refreshed; untouched rows keep their values.

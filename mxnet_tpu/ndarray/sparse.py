@@ -18,7 +18,7 @@ import numpy as np
 from .ndarray import NDArray, array, zeros as _dense_zeros
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage", "zeros", "retain"]
+           "cast_storage", "zeros", "retain", "dot"]
 
 
 class BaseSparseNDArray(NDArray):
@@ -29,6 +29,9 @@ class BaseSparseNDArray(NDArray):
 
     def asnumpy(self):
         return self.todense().asnumpy()
+
+    def dot(self, other):
+        return dot(self, other)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -74,7 +77,25 @@ class RowSparseNDArray(BaseSparseNDArray):
             return self.todense()
         raise ValueError("cast row_sparse -> %s not supported" % stype)
 
+    def as_in_context(self, ctx):
+        """Context move preserving sparsity (the reference's rsp
+        CopyFromTo keeps storage type; densifying here would defeat the
+        lazy-update path for cross-context kvstores)."""
+        if ctx == self._ctx:
+            return self
+        import jax
+
+        return RowSparseNDArray(
+            NDArray(jax.device_put(self._data, ctx.jax_device), ctx=ctx),
+            NDArray(jax.device_put(self._indices._data, ctx.jax_device),
+                    ctx=ctx),
+            self._full_shape, ctx=ctx)
+
     def copyto(self, other):
+        from ..context import Context
+
+        if isinstance(other, Context):
+            return self.as_in_context(other)
         return self.todense().copyto(other)
 
     def __repr__(self):
@@ -157,7 +178,8 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
-    """Create a CSRNDArray (reference: sparse.py:csr_matrix)."""
+    """Create a CSRNDArray (reference: sparse.py:csr_matrix). Dense input
+    converts fully vectorized (one nonzero scan — no per-row loop)."""
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         return CSRNDArray(array(np.asarray(data, dtype=dtype or np.float32)),
@@ -166,17 +188,13 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
                           shape, ctx=ctx)
     dense = np.asarray(arg1, dtype=dtype or np.float32)
     m, n = dense.shape
-    indptr = [0]
-    indices = []
-    data = []
-    for r in range(m):
-        nz = np.where(dense[r] != 0)[0]
-        indices.extend(nz.tolist())
-        data.extend(dense[r, nz].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(array(np.asarray(data, np.float32)),
-                      array(np.asarray(indptr), dtype="int64"),
-                      array(np.asarray(indices), dtype="int64"),
+    rows, cols = np.nonzero(dense)
+    indptr = np.zeros(m + 1, np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr)
+    return CSRNDArray(array(dense[rows, cols]),
+                      array(indptr, dtype="int64"),
+                      array(cols.astype(np.int64), dtype="int64"),
                       (m, n), ctx=ctx)
 
 
@@ -208,6 +226,87 @@ def zeros(stype, shape, ctx=None, dtype=None):
             array(np.zeros((shape[0] + 1,), np.int64), dtype="int64"),
             array(np.zeros((0,), np.int64), dtype="int64"), shape, ctx=ctx)
     raise ValueError(stype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference src/operator/tensor/dot-inl.h —
+    dot(csr, dense) and dot(csr.T, dense) FComputeEx kernels).
+
+    TPU lowering: the CSR contraction is gather + segment-sum over the
+    nnz stream — `out[row[k]] += data[k] * rhs[col[k]]` via
+    `jax.ops.segment_sum` (one XLA scatter-add, VPU path); the transposed
+    form scatter-adds into the output rows. Dense inputs fall through to
+    the dense op.
+    """
+    from .ndarray import _invoke
+
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        import jax
+        import jax.numpy as jnp
+
+        assert not transpose_b, "dot(csr, dense, transpose_b) unsupported"
+        m, n = lhs.shape
+        indptr = lhs.indptr._data
+        cols = lhs.indices._data.astype(jnp.int32)
+        vals = lhs.data._data
+        nnz = cols.shape[0]
+        rows = jnp.searchsorted(indptr.astype(jnp.int32),
+                                jnp.arange(nnz), side="right") - 1
+        r = rhs._data
+        vec_rhs = r.ndim == 1
+        if vec_rhs:
+            r = r[:, None]
+        if not transpose_a:
+            # (m, n) x (n, k) -> (m, k)
+            contrib = vals[:, None] * r[cols]
+            out = jax.ops.segment_sum(contrib, rows, num_segments=m)
+        else:
+            # (n, m) <- csr.T: out[col] += val * rhs[row]
+            contrib = vals[:, None] * r[rows]
+            out = jax.ops.segment_sum(contrib, cols, num_segments=n)
+        if vec_rhs:
+            out = out[:, 0]
+        return NDArray(out, ctx=lhs.context)
+    if isinstance(lhs, RowSparseNDArray) or isinstance(rhs,
+                                                      BaseSparseNDArray):
+        # Remaining sparse combos take the storage-fallback path
+        # (reference kFComputeFallback): densify, dense kernel.
+        lhs = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+        rhs = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _invoke("dot", [lhs, rhs], transpose_a=transpose_a,
+                   transpose_b=transpose_b)
+
+
+def _gather_rows(rsp, row_ids_np):
+    """Rows of a RowSparseNDArray by id — absent rows are zeros; no
+    densification (reference kvstore PullRowSparse semantics). Handles
+    unsorted stored indices and empty stores."""
+    import jax.numpy as jnp
+
+    req_np = np.asarray(row_ids_np)
+    if rsp.indices.shape[0] == 0:
+        return NDArray(jnp.zeros((len(req_np),) + tuple(rsp.shape[1:]),
+                                 rsp._data.dtype), ctx=rsp.context)
+    stored_idx = rsp.indices._data
+    vals = rsp._data
+    order = jnp.argsort(stored_idx)
+    sorted_idx = stored_idx[order]
+    req = jnp.asarray(req_np, sorted_idx.dtype)
+    pos = jnp.searchsorted(sorted_idx, req)
+    pos = jnp.clip(pos, 0, sorted_idx.shape[0] - 1)
+    hit = sorted_idx[pos] == req
+    rows = vals[order[pos]] * hit[:, None].astype(vals.dtype)
+    return NDArray(rows, ctx=rsp.context)
+
+
+def _aggregate_rsp(values_np, indices_np, shape, ctx=None):
+    """Sum duplicate row ids into one sorted RowSparseNDArray (the merge
+    step of the reference's rsp reduce, comm.h sparse path)."""
+    uniq, inv = np.unique(np.asarray(indices_np), return_inverse=True)
+    out = np.zeros((len(uniq),) + tuple(shape[1:]), np.float32)
+    np.add.at(out, inv, np.asarray(values_np, np.float32))
+    return RowSparseNDArray(array(out), array(uniq, dtype="int64"),
+                            shape, ctx=ctx)
 
 
 def retain(arr, indices):

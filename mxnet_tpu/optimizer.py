@@ -27,6 +27,80 @@ __all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "NAG", "Adam", "AdaGrad",
 registry = Registry("optimizer")
 
 
+def _is_rsp(grad):
+    from .ndarray.sparse import RowSparseNDArray
+
+    return isinstance(grad, RowSparseNDArray)
+
+
+def _rsp_rows(grad):
+    """Deduplicated (indices, values) of a row_sparse gradient."""
+    from .ndarray.sparse import _aggregate_rsp
+
+    agg = _aggregate_rsp(grad.data.asnumpy(), grad.indices.asnumpy(),
+                         grad.shape, ctx=grad.context)
+    return agg.indices._data, agg.data._data
+
+
+def _sparse_sgd_update(weight, grad, state, lr, momentum, wd, rescale,
+                       clip, lazy):
+    """Lazy row-sparse SGD: only rows present in the gradient are
+    touched — weight decay and momentum decay apply to those rows alone
+    (reference optimizer_op.cc sparse sgd/sgd_mom `lazy_update=True`
+    semantics). Device math is one gather + scatter; duplicate-row
+    aggregation currently round-trips through host numpy (eager path —
+    acceptable while updates are host-driven, noted for the compiled
+    path)."""
+    import jax.numpy as jnp
+
+    idx, g_raw = _rsp_rows(grad)
+    g_raw = g_raw * rescale
+    if clip is not None and clip > 0:   # <=0 is the "no clip" sentinel
+        g_raw = jnp.clip(g_raw, -clip, clip)
+    w_rows = weight._data[idx]
+    g = g_raw + wd * w_rows
+    if state is None:
+        if lazy or wd == 0.0:
+            weight._set_data(weight._data.at[idx].add(-lr * g))
+        else:
+            # std update decays every row (grad rows get the full step)
+            new_w = weight._data * (1.0 - lr * wd)
+            weight._set_data(new_w.at[idx].add(-lr * g_raw))
+        return
+    if not lazy:
+        # standard momentum: every row sees momentum decay + weight
+        # decay; gradient rows additionally get -lr*grad (reference
+        # sgd_mom_update with a dense-ified sparse grad).
+        new_m = state._data * momentum - lr * wd * weight._data
+        new_m = new_m.at[idx].add(-lr * g_raw)
+        state._set_data(new_m)
+        weight._set_data(weight._data + new_m)
+        return
+    m_rows = state._data[idx] * momentum - lr * g
+    state._set_data(state._data.at[idx].set(m_rows))
+    weight._set_data(weight._data.at[idx].add(m_rows))
+
+
+def _sparse_adam_update(weight, grad, mean, var, lr_t, beta1, beta2,
+                        epsilon, wd, rescale, clip):
+    """Lazy row-sparse Adam (reference optimizer_op.cc adam FComputeEx:
+    rows absent from the gradient keep stale moments)."""
+    import jax.numpy as jnp
+
+    idx, g = _rsp_rows(grad)
+    g = g * rescale
+    if clip is not None and clip > 0:   # <=0 is the "no clip" sentinel
+        g = jnp.clip(g, -clip, clip)
+    w_rows = weight._data[idx]
+    g = g + wd * w_rows
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * g * g
+    mean._set_data(mean._data.at[idx].set(m_rows))
+    var._set_data(var._data.at[idx].set(v_rows))
+    step = lr_t * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    weight._set_data(weight._data.at[idx].add(-step))
+
+
 def register(cls):
     return registry.register(cls)
 
@@ -138,6 +212,11 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if _is_rsp(grad):
+            _sparse_sgd_update(weight, grad, state, lr, self.momentum, wd,
+                               self.rescale_grad, self._clip(),
+                               self.lazy_update)
+            return
         if state is None:
             nd.sgd_update(weight, grad, lr=lr, wd=wd,
                           rescale_grad=self.rescale_grad,
@@ -227,6 +306,11 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * (coef2 ** 0.5) / coef1
         mean, var = state
+        if _is_rsp(grad):
+            _sparse_adam_update(weight, grad, mean, var, lr_t, self.beta1,
+                                self.beta2, self.epsilon, wd,
+                                self.rescale_grad, self._clip())
+            return
         nd.adam_update(weight, grad, mean, var, lr=lr_t, beta1=self.beta1,
                        beta2=self.beta2, epsilon=self.epsilon, wd=wd,
                        rescale_grad=self.rescale_grad,

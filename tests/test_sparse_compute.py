@@ -1,0 +1,234 @@
+"""Sparse compute path: CSR dot via segment ops, lazy row_sparse
+optimizer updates, non-densifying kvstore pulls, and a LibSVM linear
+model converging with CSR data + row_sparse weights (reference:
+tests/python/unittest/test_sparse_operator.py, test_sparse_ndarray.py,
+tests/python/train/test_sparse_fm.py; VERDICT missing #5)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _rand_csr(rng, m, n, density=0.3):
+    dense = rng.rand(m, n) * (rng.rand(m, n) < density)
+    return sp.csr_matrix(dense.astype(np.float32)), dense.astype(np.float32)
+
+
+def test_csr_matrix_vectorized():
+    rng = np.random.RandomState(0)
+    csr, dense = _rand_csr(rng, 13, 7)
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    # rows with no nonzeros round-trip
+    z = sp.csr_matrix(np.zeros((3, 4), np.float32))
+    np.testing.assert_allclose(z.asnumpy(), 0)
+
+
+def test_dot_csr_dense():
+    rng = np.random.RandomState(1)
+    csr, dense = _rand_csr(rng, 9, 6)
+    rhs = mx.nd.array(rng.rand(6, 4).astype(np.float32))
+    out = sp.dot(csr, rhs)
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs.asnumpy(),
+                               rtol=1e-5)
+    # method form
+    out2 = csr.dot(rhs)
+    np.testing.assert_allclose(out2.asnumpy(), out.asnumpy())
+
+
+def test_dot_csr_dense_transpose():
+    rng = np.random.RandomState(2)
+    csr, dense = _rand_csr(rng, 9, 6)
+    rhs = mx.nd.array(rng.rand(9, 3).astype(np.float32))
+    out = sp.dot(csr, rhs, transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), dense.T @ rhs.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_sparse_sgd_lazy_update():
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9, wd=0.0)
+    w = mx.nd.ones((6, 3))
+    state = opt.create_state(0, w)
+    grad = sp.row_sparse_array(
+        (np.full((2, 3), 1.0, np.float32), np.array([1, 4])), shape=(6, 3))
+    opt.update(0, w, grad, state)
+    wn = w.asnumpy()
+    # untouched rows unchanged (lazy), touched rows stepped
+    np.testing.assert_allclose(wn[0], 1.0)
+    np.testing.assert_allclose(wn[1], 1.0 - 0.5)
+    # momentum state only on touched rows
+    st = state.asnumpy()
+    assert np.all(st[0] == 0) and np.all(st[1] != 0)
+    # second update compounds momentum on touched rows only
+    opt.update(0, w, grad, state)
+    np.testing.assert_allclose(w.asnumpy()[0], 1.0)
+    np.testing.assert_allclose(w.asnumpy()[1], 1.0 - 0.5 - (0.9 * 0.5 + 0.5))
+
+
+def test_sparse_adam_update_duplicates_aggregate():
+    opt = mx.optimizer.Adam(learning_rate=0.1)
+    w = mx.nd.ones((5, 2))
+    state = opt.create_state(0, w)
+    # duplicate indices must sum before the moment update
+    grad = sp.row_sparse_array(
+        (np.array([[1.0, 1.0], [2.0, 2.0]], np.float32),
+         np.array([2, 2])), shape=(5, 2))
+    opt.update(0, w, grad, state)
+    wn = w.asnumpy()
+    assert np.all(wn[0] == 1.0) and np.all(wn[2] < 1.0)
+    mean = state[0].asnumpy()
+    np.testing.assert_allclose(mean[2], 0.1 * 3.0)   # (1-beta1)*(1+2)
+
+
+def test_kvstore_row_sparse_pull_no_densify():
+    kv = mx.kv.create("local")
+    init = sp.row_sparse_array(
+        (np.arange(6, dtype=np.float32).reshape(3, 2),
+         np.array([0, 2, 5])), shape=(8, 2))
+    kv.init("emb", init)
+    out = sp.zeros("row_sparse", (3, 2))
+    kv.row_sparse_pull("emb", out=out,
+                       row_ids=mx.nd.array([0, 3, 5], dtype="int64"))
+    got = out.data.asnumpy()
+    np.testing.assert_allclose(got[0], [0, 1])       # stored row 0
+    np.testing.assert_allclose(got[1], [0, 0])       # absent row -> 0
+    np.testing.assert_allclose(got[2], [4, 5])       # stored row 5
+
+
+def test_sparse_embedding_trains_lazily():
+    """SparseEmbedding + Trainer: gradient flows as row_sparse, lazy
+    updates touch only seen rows."""
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+
+    emb = SparseEmbedding(50, 4)
+    emb.initialize()
+    w0 = emb.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.9})
+    idx = mx.nd.array(np.array([3, 7, 3], np.float32))
+    with autograd.record():
+        out = emb(idx)
+        loss = (out * out).sum()
+    loss.backward()
+    trainer.step(1)
+    w1 = emb.weight.data().asnumpy()
+    changed = np.where(np.abs(w1 - w0).sum(axis=1) > 0)[0]
+    assert set(changed.tolist()) == {3, 7}
+
+
+def test_libsvm_linear_model_converges():
+    """Sparse logistic regression: CSR features, row_sparse weight,
+    gradients via dot(csr.T, residual) — the reference's sparse linear
+    benchmark pattern (benchmark/python/sparse, test_sparse_fm)."""
+    rng = np.random.RandomState(0)
+    n, d = 200, 60
+    dense = (rng.rand(n, d) * (rng.rand(n, d) < 0.15)).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = (dense @ w_true > 0).astype(np.float32)
+
+    # write libsvm file, read through LibSVMIter
+    with tempfile.NamedTemporaryFile("w", suffix=".libsvm",
+                                     delete=False) as f:
+        for i in range(n):
+            cols = np.nonzero(dense[i])[0]
+            f.write("%d %s\n" % (y[i], " ".join(
+                "%d:%.6f" % (c, dense[i, c]) for c in cols)))
+        path = f.name
+    try:
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(d,),
+                              batch_size=50)
+        w = mx.nd.zeros((d, 1))
+        bias = mx.nd.zeros((1,))
+        lr = 2.0
+        losses = []
+        for epoch in range(50):
+            it.reset()
+            total, count = 0.0, 0
+            for batch in it:
+                Xb = batch.data[0]               # CSRNDArray
+                yb = batch.label[0].reshape((-1, 1))
+                logits = sp.dot(Xb, w) + bias
+                p = logits.sigmoid()
+                eps = 1e-7
+                loss = -(yb * (p + eps).log()
+                         + (1 - yb) * (1 - p + eps).log()).mean()
+                resid = (p - yb) / Xb.shape[0]
+                gw = sp.dot(Xb, resid, transpose_a=True)
+                w -= lr * gw
+                bias -= lr * resid.sum()
+                total += float(loss.asnumpy())
+                count += 1
+            losses.append(total / count)
+        assert losses[-1] < losses[0] * 0.5, losses[::7]
+        # training accuracy
+        pred = (dense @ w.asnumpy().ravel() + float(bias.asnumpy()) > 0)
+        acc = (pred == (y > 0)).mean()
+        assert acc > 0.9, "sparse linear model accuracy %.3f" % acc
+    finally:
+        os.unlink(path)
+
+
+def test_gather_rows_unsorted_and_empty():
+    """Regressions: unsorted stored indices and empty stores."""
+    kv = mx.kv.create("local")
+    vals = np.array([[10., 11.], [20., 21.]], np.float32)
+    kv.init("u", sp.row_sparse_array((vals, np.array([5, 1])), shape=(8, 2)))
+    out = mx.nd.zeros((2, 2))
+    kv.row_sparse_pull("u", out=out,
+                       row_ids=mx.nd.array([1, 5], dtype="int64"))
+    np.testing.assert_allclose(out.asnumpy(), [[20, 21], [10, 11]])
+    # empty store -> zeros, no crash
+    kv.init("e", sp.zeros("row_sparse", (4, 2)))
+    out2 = mx.nd.zeros((2, 2))
+    kv.row_sparse_pull("e", out=out2,
+                       row_ids=mx.nd.array([0, 3], dtype="int64"))
+    np.testing.assert_allclose(out2.asnumpy(), 0)
+
+
+def test_dot_csr_vector_rhs():
+    csr = sp.csr_matrix(np.array([[1., 0., 2.], [0., 3., 0.]], np.float32))
+    v = mx.nd.array(np.array([1., 1., 1.], np.float32))
+    out = sp.dot(csr, v)
+    assert out.shape == (2,)
+    np.testing.assert_allclose(out.asnumpy(), [3., 3.])
+    out_t = sp.dot(csr, mx.nd.array(np.array([1., 1.], np.float32)),
+                   transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), [1., 3., 2.])
+
+
+def test_rsp_cross_context_keeps_sparsity():
+    """as_in_context preserves row_sparse storage (no silent densify in
+    cross-context kvstore pushes)."""
+    rsp = sp.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([1, 4])), shape=(6, 3))
+    moved = rsp.as_in_context(mx.cpu(1))
+    assert isinstance(moved, sp.RowSparseNDArray)
+    assert moved.indices.shape == (2,)
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((6, 3)))
+    opt = mx.optimizer.SGD(learning_rate=1.0)
+    kv.set_optimizer(opt)
+    kv.push("w", rsp.as_in_context(mx.cpu(1)))
+    out = mx.nd.zeros((6, 3))
+    kv.pull("w", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], -1.0)
+    np.testing.assert_allclose(got[0], 0.0)
+
+
+def test_sparse_sgd_std_update_decays_all_rows():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.1,
+                           lazy_update=False)
+    w = mx.nd.ones((4, 2))
+    state = opt.create_state(0, w)
+    grad = sp.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([2])), shape=(4, 2))
+    opt.update(0, w, grad, state)
+    wn = w.asnumpy()
+    # untouched rows still decay by lr*wd under std update
+    np.testing.assert_allclose(wn[0], 1.0 - 0.1 * 0.1, rtol=1e-6)
+    assert wn[2][0] < wn[0][0]
