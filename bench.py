@@ -70,32 +70,21 @@ def _row(metric, img_s, baseline, gflop_per_img):
 
 
 def _train_rate(batch, dtype, device):
-    import jax
-    import jax.numpy as jnp
-    from mxnet_tpu import gluon
-    from mxnet_tpu.gluon.model_zoo import vision
-    from mxnet_tpu.parallel import TrainStep, make_mesh
+    """Training rows run THROUGH the example driver (the reference's
+    numbers are measured through train_imagenet.py the same way)."""
+    import sys
 
-    net = vision.resnet50_v1(classes=1000)
-    net.initialize()
-    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
-                     optimizer="sgd",
-                     optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
-                                       "wd": 1e-4},
-                     mesh=make_mesh({"dp": 1}, devices=[device]),
-                     dtype=dtype)
-    rng = np.random.RandomState(0)
-    x = rng.rand(batch, 3, 224, 224).astype(np.float32)
-    y = rng.randint(0, 1000, batch).astype(np.float32)
-    step(x, y)  # materialize + compile
-    # Device-resident inputs: __call__'s device_put becomes a no-op.
-    x = jax.device_put(jnp.asarray(x), step._data_sharding)
-    y = jax.device_put(jnp.asarray(y), step._data_sharding)
-    # Steps chain through donated params; reading the last loss proves
-    # the whole window ran. Small batches get longer windows: per-step
-    # dispatch latency through the device tunnel is the noise floor.
-    return _measure(lambda: step(x, y), lambda loss: float(loss),
-                    batch, iters=16 if batch <= 32 else 10)
+    examples_dir = os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "examples")
+    if examples_dir not in sys.path:
+        sys.path.insert(0, examples_dir)
+    from train_imagenet import benchmark_rate
+
+    # Small batches get longer windows: per-step dispatch latency
+    # through the device tunnel is the noise floor.
+    return benchmark_rate("resnet50", batch, dtype, device=device,
+                          iters=16 if batch <= 32 else 10,
+                          windows=WINDOWS, warmup=WARMUP)
 
 
 def _infer_rate(batch, dtype, device):

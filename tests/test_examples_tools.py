@@ -1,0 +1,83 @@
+"""Examples and tools run end-to-end (reference: the drivers under
+example/image-classification and tools/ — train_mnist, train_imagenet
+--benchmark, im2rec, bandwidth/measure)."""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=2")
+
+
+def _run(cmd, timeout=240):
+    res = subprocess.run(cmd, capture_output=True, text=True, env=_ENV,
+                         timeout=timeout, cwd=_ROOT)
+    assert res.returncode == 0, \
+        "cmd %s failed:\n%s\n%s" % (cmd, res.stdout[-2000:],
+                                    res.stderr[-2000:])
+    return res.stdout
+
+
+def test_train_mnist_synthetic():
+    out = _run([sys.executable, "examples/train_mnist.py", "--synthetic",
+                "--num-examples", "1500", "--num-epochs", "4",
+                "--network", "mlp", "--lr", "0.5"])
+    line = [l for l in out.splitlines() if l.startswith("final-accuracy")]
+    assert line, out
+    acc = float(line[0].split()[1])
+    assert acc > 0.8, "mnist driver accuracy %.3f" % acc
+
+
+def test_train_imagenet_benchmark_mode():
+    out = _run([sys.executable, "examples/train_imagenet.py",
+                "--benchmark", "1", "--network", "resnet18",
+                "--batch-size", "2", "--image-shape", "3,64,64"],
+               timeout=400)
+    line = [l for l in out.splitlines() if l.startswith("benchmark:")]
+    assert line, out
+    assert float(line[0].split()[-2]) > 0
+
+
+def test_im2rec_roundtrip():
+    cv2 = pytest.importorskip("cv2")
+    import mxnet_tpu as mx
+
+    with tempfile.TemporaryDirectory() as d:
+        rng = np.random.RandomState(0)
+        for cls in ("cat", "dog"):
+            os.makedirs(os.path.join(d, "imgs", cls))
+            for i in range(3):
+                img = (rng.rand(20, 24, 3) * 255).astype(np.uint8)
+                cv2.imwrite(os.path.join(d, "imgs", cls,
+                                         "%d.jpg" % i), img)
+        prefix = os.path.join(d, "set")
+        _run([sys.executable, "tools/im2rec.py", prefix,
+              os.path.join(d, "imgs")])
+        assert os.path.exists(prefix + ".rec")
+        assert os.path.exists(prefix + ".idx")
+        # readable through the training-side iterator
+        it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                                   batch_size=2, data_shape=(3, 20, 20))
+        batch = next(iter(it))
+        assert batch.data[0].shape == (2, 3, 20, 20)
+        labels = set()
+        it.reset()
+        for b in it:
+            labels.update(b.label[0].asnumpy().tolist())
+        assert {0.0, 1.0} <= labels
+
+
+def test_bandwidth_measure():
+    sys.path.insert(0, os.path.join(_ROOT, "tools", "bandwidth"))
+    from measure import measure
+
+    rows = measure("device", num_devices=2, sizes=(4096,), repeat=2,
+                   warmup=1)
+    assert len(rows) == 1
+    size, dt, gbs = rows[0]
+    assert dt > 0 and gbs > 0

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""KVStore communication micro-benchmark.
+
+Reference: tools/bandwidth/measure.py — times push+pull rounds over a
+kvstore for configurable array sizes / device counts and reports the
+implied per-batch communication cost and aggregate bandwidth, the tool
+the reference docs point at for scaling studies (perf.md:218-231).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def measure(kv_type="device", num_devices=2, sizes=(1024 * 1024,),
+            repeat=5, warmup=2):
+    """Return [(size, avg_seconds, GB/s)] for push+pull rounds."""
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create(kv_type)
+    results = []
+    ctxs = [mx.Context(mx.context.Context.default_ctx().device_type, i)
+            for i in range(num_devices)]
+    for size in sizes:
+        key = "b%d" % size
+        kv.init(key, mx.nd.zeros((size,), ctx=ctxs[0]))
+        vals = [mx.nd.ones((size,), ctx=c) for c in ctxs]
+        outs = [mx.nd.zeros((size,), ctx=c) for c in ctxs]
+
+        def round_trip():
+            kv.push(key, vals)
+            kv.pull(key, out=outs)
+            outs[0].wait_to_read()
+            return float(outs[0].asnumpy()[0])   # completion proof
+
+        for _ in range(warmup):
+            round_trip()
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            round_trip()
+        dt = (time.perf_counter() - t0) / repeat
+        # bytes moved per round: each device sends + receives the array
+        gbs = (2 * num_devices * size * 4) / dt / 1e9
+        results.append((size, dt, gbs))
+    if hasattr(kv, "close"):
+        kv.close()
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="measure kvstore communication cost",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--kv-store", default="device")
+    parser.add_argument("--num-devices", type=int, default=2)
+    parser.add_argument("--sizes", default="262144,1048576,4194304",
+                        help="comma-separated float32 element counts")
+    parser.add_argument("--repeat", type=int, default=5)
+    args = parser.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+    rows = measure(args.kv_store, args.num_devices, sizes, args.repeat)
+    print("%12s %12s %10s" % ("elements", "sec/round", "GB/s"))
+    for size, dt, gbs in rows:
+        print("%12d %12.6f %10.3f" % (size, dt, gbs))
+
+
+if __name__ == "__main__":
+    main()
