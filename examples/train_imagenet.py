@@ -79,7 +79,8 @@ def benchmark_rate(network="resnet50", batch=32, dtype=None, device=None,
     y = jax.device_put(jnp.asarray(y), step._data_sharding)
     for _ in range(warmup):
         loss = step(x, y)
-    float(loss)
+    if warmup:
+        float(loss)                              # drain the warmup chain
     rates = []
     for _ in range(windows):
         t0 = time.perf_counter()
@@ -131,6 +132,9 @@ def main():
                             lr=args.lr, momentum=args.mom, wd=args.wd)
     if args.data_train:
         idx_path = os.path.splitext(args.data_train)[0] + ".idx"
+        if not os.path.exists(idx_path):
+            logging.warning("no %s: shuffle is a no-op without the index "
+                            "(regenerate with tools/im2rec.py)", idx_path)
         it = mx.io.ImageRecordIter(
             path_imgrec=args.data_train,
             path_imgidx=idx_path if os.path.exists(idx_path) else None,

@@ -50,8 +50,10 @@ def get_lenet():
     return mx.sym.SoftmaxOutput(f2, name="softmax")
 
 
-def synthetic_iters(args, flat):
-    """MNIST-shaped synthetic digits: class = argmax row-band energy."""
+def synthetic_iters(args, flat, rank=0, num_workers=1):
+    """MNIST-shaped synthetic digits: class = argmax row-band energy.
+    Sharded across dist workers (reference drivers pass num_parts/
+    part_index so each worker sees its own slice)."""
     rng = np.random.RandomState(42)
     n = args.num_examples
     X = (rng.rand(n, 1, 28, 28) * 0.25).astype(np.float32)
@@ -62,7 +64,12 @@ def synthetic_iters(args, flat):
     if flat:
         X = X.reshape(n, 784)
     cut = int(n * 0.9)
-    train = mx.io.NDArrayIter(X[:cut], y[:cut].astype(np.float32),
+    Xt, yt = X[:cut], y[:cut].astype(np.float32)
+    if num_workers > 1:
+        part = len(Xt) // num_workers
+        Xt = Xt[rank * part:(rank + 1) * part]
+        yt = yt[rank * part:(rank + 1) * part]
+    train = mx.io.NDArrayIter(Xt, yt,
                               batch_size=args.batch_size, shuffle=True,
                               label_name="softmax_label")
     val = mx.io.NDArrayIter(X[cut:], y[cut:].astype(np.float32),
@@ -71,12 +78,13 @@ def synthetic_iters(args, flat):
     return train, val
 
 
-def mnist_iters(args, flat):
+def mnist_iters(args, flat, rank=0, num_workers=1):
     prefix = args.data_dir
     train = mx.io.MNISTIter(
         image=os.path.join(prefix, "train-images-idx3-ubyte"),
         label=os.path.join(prefix, "train-labels-idx1-ubyte"),
-        batch_size=args.batch_size, shuffle=True, flat=flat)
+        batch_size=args.batch_size, shuffle=True, flat=flat,
+        num_parts=num_workers, part_index=rank)
     val = mx.io.MNISTIter(
         image=os.path.join(prefix, "t10k-images-idx3-ubyte"),
         label=os.path.join(prefix, "t10k-labels-idx1-ubyte"),
@@ -109,10 +117,12 @@ def main():
     kv = mx.kv.create(args.kv_store)
     have_mnist = os.path.exists(os.path.join(
         args.data_dir, "train-images-idx3-ubyte"))
+    rank = getattr(kv, "rank", 0)
+    num_workers = getattr(kv, "num_workers", 1)
     if args.synthetic or not have_mnist:
-        train, val = synthetic_iters(args, flat)
+        train, val = synthetic_iters(args, flat, rank, num_workers)
     else:
-        train, val = mnist_iters(args, flat)
+        train, val = mnist_iters(args, flat, rank, num_workers)
 
     if args.gpus:
         ctx = [mx.gpu(int(i)) for i in args.gpus.split(",")]

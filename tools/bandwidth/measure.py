@@ -26,8 +26,17 @@ def measure(kv_type="device", num_devices=2, sizes=(1024 * 1024,),
 
     kv = mx.kv.create(kv_type)
     results = []
-    ctxs = [mx.Context(mx.context.Context.default_ctx().device_type, i)
-            for i in range(num_devices)]
+    dev_type = mx.context.Context.default_ctx().device_type
+    import jax
+
+    avail = len([d for d in jax.devices()
+                 if (d.platform == "cpu") == (dev_type == "cpu")])
+    if num_devices > avail:
+        raise SystemExit(
+            "requested %d devices but only %d %s device(s) exist — the "
+            "measured traffic would be same-device copies"
+            % (num_devices, avail, dev_type))
+    ctxs = [mx.Context(dev_type, i) for i in range(num_devices)]
     for size in sizes:
         key = "b%d" % size
         kv.init(key, mx.nd.zeros((size,), ctx=ctxs[0]))
