@@ -62,6 +62,7 @@ _LAZY = {
     "contrib": ".contrib",
     "rtc": ".rtc",
     "util": ".util",
+    "env": ".env",
     "registry": ".registry_util",
     "attribute": ".attribute",
     "name": ".name",

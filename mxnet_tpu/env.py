@@ -1,0 +1,101 @@
+"""Runtime configuration knob catalogue.
+
+Reference: docs/faq/env_var.md:18-171 — the reference catalogues every
+`MXNET_*` env var (engine threads, executor bulking, memory pool,
+kvstore, cuDNN autotune...). This module is the equivalent: one table of
+every knob this framework reads, with type, default, and where it acts;
+`describe()` renders it, `get(name)` reads with the right type.
+
+Knobs whose reference mechanism is subsumed by XLA/PJRT are listed with
+`subsumed=True` and are accepted-but-inert (e.g. worker thread counts —
+PJRT owns the thread pools), so reference launch scripts run unchanged.
+"""
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+
+__all__ = ["CATALOGUE", "get", "describe"]
+
+Knob = namedtuple("Knob", "name typ default where doc subsumed")
+
+CATALOGUE = [
+    Knob("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice", "engine.py",
+         "NaiveEngine = serial debug oracle (block after every op); "
+         "default = async JAX dispatch", False),
+    Knob("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000, "kvstore_dist.py",
+         "dist kvstore: arrays >= this many elements shard across all "
+         "servers", False),
+    Knob("MXNET_KVSTORE_DEBUG", int, 0, "kvstore_server.py",
+         "verbose parameter-server tracing", False),
+    Knob("MXNET_TPU_PS_TIMEOUT", float, 300.0, "kvstore_server.py",
+         "dist rendezvous/barrier/pull timeout in seconds", False),
+    Knob("MXNET_TPU_PS_AUTHKEY", str, "mxnet_tpu_kvstore",
+         "kvstore_server.py", "dist transport auth key", False),
+    Knob("MXNET_WORKER_START_METHOD", str, "fork", "gluon/data/dataloader.py",
+         "DataLoader worker start method: fork | forkserver | spawn",
+         False),
+    Knob("MXNET_PROFILER_AUTOSTART", int, 0, "profiler.py",
+         "start device+dispatch profiling at import", False),
+    Knob("DMLC_ROLE", str, "worker", "kvstore_server.py",
+         "process role: worker | server | scheduler (set by "
+         "tools/launch.py)", False),
+    Knob("DMLC_PS_ROOT_URI", str, "127.0.0.1", "kvstore_server.py",
+         "scheduler host", False),
+    Knob("DMLC_PS_ROOT_PORT", int, 9091, "kvstore_server.py",
+         "scheduler port", False),
+    Knob("DMLC_NUM_WORKER", int, 1, "kvstore_server.py",
+         "worker count of the dist group", False),
+    Knob("DMLC_NUM_SERVER", int, 1, "kvstore_server.py",
+         "server count of the dist group", False),
+    # -- accepted-but-subsumed (XLA/PJRT owns the mechanism) -----------------
+    Knob("MXNET_CPU_WORKER_NTHREADS", int, 1, "(subsumed)",
+         "reference engine CPU worker threads; PJRT owns thread pools",
+         True),
+    Knob("MXNET_GPU_WORKER_NTHREADS", int, 2, "(subsumed)",
+         "reference per-GPU worker threads; PJRT owns streams", True),
+    Knob("MXNET_EXEC_ENABLE_INPLACE", bool, True, "(subsumed)",
+         "reference in-place memory planning; XLA buffer assignment",
+         True),
+    Knob("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True, "(subsumed)",
+         "reference engine op bulking; whole-graph XLA compilation", True),
+    Knob("MXNET_GPU_MEM_POOL_TYPE", str, "Naive", "(subsumed)",
+         "reference GPU memory pool strategy; PJRT allocator", True),
+    Knob("MXNET_GPU_MEM_POOL_RESERVE", int, 5, "(subsumed)",
+         "reference pool reserve percentage; PJRT allocator", True),
+    Knob("MXNET_CUDNN_AUTOTUNE_DEFAULT", int, 1, "(subsumed)",
+         "cuDNN conv algo autotune; XLA picks conv algorithms", True),
+    Knob("MXNET_ENABLE_GPU_P2P", bool, True, "(subsumed)",
+         "GPU peer-to-peer; ICI topology is XLA's", True),
+    Knob("MXNET_KVSTORE_USETREE", bool, False, "(subsumed)",
+         "topology-aware reduction trees; XLA collective scheduling",
+         True),
+    Knob("MXNET_BACKWARD_DO_MIRROR", bool, False, "(subsumed)",
+         "gradient mirroring memory-for-compute; use jax.checkpoint "
+         "inside blocks instead", True),
+]
+
+_BY_NAME = {k.name: k for k in CATALOGUE}
+
+
+def get(name, default=None):
+    """Read a catalogued knob with its declared type."""
+    k = _BY_NAME.get(name)
+    if k is None:
+        return os.environ.get(name, default)
+    raw = os.environ.get(name)
+    if raw is None:
+        return k.default if default is None else default
+    if k.typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return k.typ(raw)
+
+
+def describe():
+    """Render the catalogue (reference env_var.md as a runtime table)."""
+    lines = ["%-34s %-10s %-22s %s" % ("Name", "Type", "Default", "Doc")]
+    for k in CATALOGUE:
+        doc = k.doc + (" [subsumed]" if k.subsumed else "")
+        lines.append("%-34s %-10s %-22s %s"
+                     % (k.name, k.typ.__name__, str(k.default), doc))
+    return "\n".join(lines)

@@ -67,7 +67,13 @@ class KVStoreDist(KVStoreLocal):
         sched_addr = (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
                       int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")))
         self._sched = _client(sched_addr)
-        self._sched.send(("register", "worker", None))
+        self._sched_lock = __import__("threading").Lock()
+        # A restarted worker rejoins under its old rank and skips the
+        # startup rendezvous (reference ps::Postoffice::is_recovery,
+        # kvstore_dist.h:52-55).
+        recover = os.environ.get("DMLC_WORKER_RECOVERY")
+        self._sched.send(("register", "worker", None,
+                          int(recover) if recover else None))
         reply = self._sched.recv()
         assert reply[0] == "registered"
         self._rank = reply[1]
@@ -77,6 +83,39 @@ class KVStoreDist(KVStoreLocal):
         for conn in self._servers:
             conn.send(("hello", self._sync))
         atexit.register(self.close)
+        self._start_heartbeat()
+
+    def _start_heartbeat(self):
+        """Periodic liveness pings to the scheduler (reference: ps-lite
+        heartbeats feeding GetDeadNodes)."""
+        import threading
+
+        interval = float(os.environ.get("MXNET_TPU_PS_HEARTBEAT", "5"))
+
+        def beat():
+            import time as _t
+
+            while not self._closed:
+                _t.sleep(interval)
+                if self._closed:
+                    return
+                try:
+                    with self._sched_lock:
+                        self._sched.send(("heartbeat",))
+                except OSError:
+                    return
+
+        threading.Thread(target=beat, daemon=True).start()
+
+    def get_dead_nodes(self, timeout=60):
+        """Ranks considered dead: dropped connections or no heartbeat
+        within `timeout` seconds (reference kvstore.h GetDeadNodes
+        region, kvstore_dist.h:121-123)."""
+        with self._sched_lock:
+            self._sched.send(("dead_nodes", float(timeout)))
+            reply = self._sched.recv()
+        assert reply[0] == "dead_nodes"
+        return reply[1]
 
     # -- identification -------------------------------------------------------
 
@@ -278,9 +317,12 @@ class KVStoreDist(KVStoreLocal):
 
     def _barrier(self):
         """Block until all workers arrive (reference kvstore.py:_barrier →
-        MXKVStoreBarrier over the ps-lite scheduler)."""
-        self._sched.send(("barrier",))
-        reply = self._sched.recv()
+        MXKVStoreBarrier over the ps-lite scheduler). Holds the scheduler
+        channel for the duration — heartbeats pause, which is fine: the
+        scheduler counts the barrier message itself as liveness."""
+        with self._sched_lock:
+            self._sched.send(("barrier",))
+            reply = self._sched.recv()
         if reply[0] != "barrier_done":
             raise RuntimeError(
                 "kvstore barrier failed (a worker died or timed out): %r"
@@ -293,8 +335,9 @@ class KVStoreDist(KVStoreLocal):
             return
         self._closed = True
         try:
-            self._sched.send(("finalize",))
-            self._sched.close()
+            with self._sched_lock:
+                self._sched.send(("finalize",))
+                self._sched.close()
         except OSError:
             pass
         for conn in self._servers:

@@ -119,14 +119,27 @@ class Scheduler:
         self._barrier = threading.Barrier(num_workers) if num_workers else None
         self._finalized = 0
         self._done = threading.Event()
+        # Liveness (reference: ps-lite heartbeats -> GetDeadNodes,
+        # kvstore_dist.h:121-123): last-contact time per worker rank,
+        # plus ranks whose connection dropped without finalize.
+        self._last_seen = {}
+        self._dead = set()
 
     def run(self):
-        """Serve until every worker has finalized, then shut servers down."""
-        total = self.num_workers + self.num_servers
-        for _ in range(total):
-            conn = self._listener.accept()
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+        """Serve until every worker has finalized, then shut servers down.
+        The accept loop keeps running after rendezvous so restarted
+        workers can re-register (reference is_recovery rejoin,
+        kvstore_dist.h:52-55)."""
+        def accept_loop():
+            while not self._done.is_set():
+                try:
+                    conn = self._listener.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
         self._done.wait(_WAIT_TIMEOUT * 4)
         self._listener.close()
 
@@ -134,14 +147,25 @@ class Scheduler:
         msg = conn.recv()
         assert msg[0] == "register", msg
         role = msg[1]
+        recover = msg[3] if len(msg) > 3 else None
         with self._lock:
             if role == "server":
                 node_id = self._next_server
                 self._next_server += 1
                 self._servers[node_id] = msg[2]
+            elif recover is not None:
+                # Restarted worker rejoining under its old rank: clear
+                # its dead mark and un-break the barrier so subsequent
+                # collective rounds can complete.
+                node_id = int(recover)
+                self._dead.discard(node_id)
+                self._last_seen[node_id] = time.time()
+                if self._barrier is not None:
+                    self._barrier.reset()
             else:
                 node_id = self._next_worker
                 self._next_worker += 1
+                self._last_seen[node_id] = time.time()
             if (self._next_worker == self.num_workers
                     and self._next_server == self.num_servers):
                 self._all_registered.set()
@@ -162,11 +186,30 @@ class Scheduler:
                 pass
             return
         # Worker command loop.
+        crashed = False
         while True:
             try:
                 msg = conn.recv()
             except (EOFError, OSError):
+                # Dropped without finalize: record the death so peers'
+                # get_dead_nodes() sees it (reference GetDeadNodes).
+                crashed = True
                 msg = ("finalize",)
+            with self._lock:
+                self._last_seen[node_id] = time.time()
+                if crashed:
+                    self._dead.add(node_id)
+            if msg[0] == "heartbeat":
+                continue
+            if msg[0] == "dead_nodes":
+                timeout = float(msg[1])
+                now = time.time()
+                with self._lock:
+                    dead = sorted(self._dead | {
+                        r for r, t in self._last_seen.items()
+                        if now - t > timeout})
+                conn.send(("dead_nodes", dead))
+                continue
             if msg[0] == "barrier":
                 try:
                     self._barrier.wait(_WAIT_TIMEOUT)

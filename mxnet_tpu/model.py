@@ -10,7 +10,7 @@ from . import ndarray as nd
 from . import symbol as sym
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "load_params"]
+           "load_params", "FeedForward"]
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -65,3 +65,113 @@ def _create_kvstore(kvstore, num_device, arg_params):
     else:
         raise TypeError("kvstore must be KVStore, str or None")
     return kv, True
+
+
+class FeedForward:
+    """Legacy training API (reference model.py:FeedForward — the pre-Module
+    interface many reference examples use). Implemented as a thin veneer
+    over Module: fit/predict/score/save/load keep the historical
+    signatures while the compiled-executor machinery underneath is the
+    Module path.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None,
+                 epoch_size=None, optimizer="sgd",
+                 initializer=None, arg_params=None, aux_params=None,
+                 begin_epoch=0, **kwargs):
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self._opt_kwargs = {k: v for k, v in kwargs.items()
+                            if k in ("learning_rate", "momentum", "wd",
+                                     "rescale_grad", "clip_gradient",
+                                     "lr_scheduler")}
+        self._module = None
+
+    def _init_module(self, data, label_names=None):
+        from .module import Module
+
+        labels = label_names or [n for n in self.symbol.list_arguments()
+                                 if n.endswith("_label") or n == "label"]
+        self._module = Module(self.symbol, context=self.ctx,
+                              label_names=labels or None)
+        return self._module
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        """(reference model.py:FeedForward.fit)."""
+        train_data = self._as_iter(X, y)
+        mod = self._init_module(train_data)
+        mod.fit(train_data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=self._opt_kwargs or
+                (("learning_rate", 0.01),),
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                allow_missing=self.arg_params is not None,
+                begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def _as_iter(self, X, y=None, batch_size=128):
+        from .io import DataIter, NDArrayIter
+
+        if isinstance(X, DataIter):
+            return X
+        return NDArrayIter(X, y, batch_size=min(batch_size, len(X)))
+
+    def predict(self, X, num_batch=None):
+        """(reference model.py:FeedForward.predict)."""
+        import numpy as np
+
+        data = self._as_iter(X)
+        if self._module is None or not self._module.binded:
+            mod = self._init_module(data)
+            mod.bind(data_shapes=data.provide_data,
+                     label_shapes=data.provide_label or None,
+                     for_training=False)
+            mod.set_params(self.arg_params or {}, self.aux_params or {},
+                           allow_missing=False)
+        outs = self._module.predict(data, num_batch=num_batch)
+        out = outs[0] if isinstance(outs, list) else outs
+        return out.asnumpy()
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        from . import metric as _metric
+
+        data = self._as_iter(X)
+        m = _metric.create(eval_metric) if isinstance(eval_metric, str) \
+            else eval_metric
+        return self._module.score(data, m, num_batch=num_batch)[0][1]
+
+    def save(self, prefix, epoch=None):
+        """(reference model.py:FeedForward.save)."""
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """(reference model.py:FeedForward.load)."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, **kwargs):
+        """(reference model.py:FeedForward.create — construct + fit)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, **kwargs)
+        model.fit(X, y)
+        return model

@@ -241,3 +241,8 @@ class Marker:
     def mark(self, scope="process"):
         _events.append((time.perf_counter(), "marker",
                         "%s::%s" % (self.domain.name, self.name), scope))
+
+
+# Reference env_var.md MXNET_PROFILER_AUTOSTART: begin profiling at import.
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") in ("1", "true"):
+    set_state("run")

@@ -192,18 +192,46 @@ def run_train(kv):
     kv._barrier()
 
 
+def run_failure(kv):
+    """Failure detection (reference tests: ps-lite heartbeat ->
+    GetDeadNodes): rank 1 dies without finalizing; rank 0 observes it via
+    get_dead_nodes and gets a loud error (not a hang) from the next
+    barrier."""
+    import time
+
+    kv.init("f", mx.nd.zeros((2,)))
+    if kv.rank == 1:
+        os._exit(0)          # simulated crash: no finalize, no atexit
+    deadline = time.time() + 60
+    dead = []
+    while time.time() < deadline:
+        dead = kv.get_dead_nodes(timeout=30)
+        if 1 in dead:
+            break
+        time.sleep(0.5)
+    assert 1 in dead, "dead worker not detected: %r" % (dead,)
+    try:
+        kv._barrier()
+    except RuntimeError:
+        pass                  # loud failure, not a silent hang
+    else:
+        raise AssertionError("barrier succeeded despite a dead worker")
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--kv-type", default="dist_sync")
     parser.add_argument("--mode", default="kvstore",
-                        choices=["kvstore", "train"])
+                        choices=["kvstore", "train", "failure"])
     args = parser.parse_args()
     print("creating kv", file=sys.stderr, flush=True)
     kv = mx.kv.create(args.kv_type)
     print("kv created rank", kv.rank, file=sys.stderr, flush=True)
     assert kv.num_workers == int(os.environ["DMLC_NUM_WORKER"])
     assert 0 <= kv.rank < kv.num_workers
-    if args.mode == "train":
+    if args.mode == "failure":
+        run_failure(kv)
+    elif args.mode == "train":
         run_train(kv)
     elif args.kv_type == "dist_async":
         run_async(kv)

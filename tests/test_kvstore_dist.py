@@ -49,6 +49,13 @@ def test_dist_async_kvstore():
     _run("dist_async")
 
 
+def test_dist_failure_detection():
+    """A worker that dies without finalize is reported by get_dead_nodes
+    and breaks barriers loudly instead of hanging (reference: ps-lite
+    heartbeats -> GetDeadNodes, kvstore_dist.h:121-123)."""
+    _run("dist_sync", mode="failure")
+
+
 def test_dist_sync_training():
     """Gluon Trainer end-to-end over dist_sync: optimizer-on-server,
     per-worker shards, identical weights across workers."""

@@ -1,0 +1,50 @@
+"""Legacy FeedForward API + env-var catalogue (reference: model.py
+FeedForward, docs/faq/env_var.md)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_feedforward_fit_predict_score(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(120, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 4).astype(np.float32)
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=15,
+                                 optimizer="adam", learning_rate=0.01)
+    model.fit(mx.io.NDArrayIter(X, y, batch_size=30, shuffle=True,
+                                label_name="softmax_label"))
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=30,
+                                        label_name="softmax_label"))
+    assert acc > 0.85, "FeedForward accuracy %.3f" % acc
+    preds = model.predict(mx.io.NDArrayIter(X, y, batch_size=30,
+                                            label_name="softmax_label"))
+    assert preds.shape == (120, 2)
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 1)
+    loaded = mx.model.FeedForward.load(prefix, 1, ctx=mx.cpu())
+    assert set(loaded.arg_params) == set(model.arg_params)
+
+
+def test_env_catalogue():
+    from mxnet_tpu import env
+
+    table = env.describe()
+    assert "MXNET_ENGINE_TYPE" in table
+    assert "[subsumed]" in table
+    assert env.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 1000000
+    import os
+
+    os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "42"
+    try:
+        assert env.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 42
+    finally:
+        del os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"]
