@@ -95,6 +95,9 @@ def main():
     parser = argparse.ArgumentParser(description="train imagenet",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     parser.add_argument("--network", default="resnet50")
+    parser.add_argument("--device", default=os.environ.get(
+        "MXNET_DEVICE", "auto"), choices=["auto", "cpu", "tpu"],
+        help="'cpu' pins the cpu backend in-process")
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--image-shape", default="3,224,224")
@@ -113,6 +116,9 @@ def main():
     parser.add_argument("--data-train", default=None,
                         help=".rec file for real training data")
     args = parser.parse_args()
+    from mxnet_tpu.util import pin_platform
+
+    pin_platform(args.device)
     logging.basicConfig(level=logging.INFO)
     shape = tuple(int(v) for v in args.image_shape.split(","))
     dtype = None if args.dtype == "float32" else args.dtype

@@ -96,6 +96,10 @@ def main():
     parser = argparse.ArgumentParser(description="train mnist")
     parser.add_argument("--network", default="mlp",
                         choices=["mlp", "lenet"])
+    parser.add_argument("--device", default=os.environ.get(
+        "MXNET_DEVICE", "auto"), choices=["auto", "cpu", "tpu"],
+        help="'cpu' pins the cpu backend in-process (reliable even "
+        "where the TPU plugin overrides JAX_PLATFORMS)")
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--lr", type=float, default=0.05)
     parser.add_argument("--num-epochs", type=int, default=5)
@@ -109,6 +113,7 @@ def main():
     parser.add_argument("--disp-batches", type=int, default=50)
     parser.add_argument("--model-prefix", default=None)
     args = parser.parse_args()
+    mx.util.pin_platform(args.device)
 
     logging.basicConfig(level=logging.INFO)
     flat = args.network == "mlp"
@@ -124,7 +129,11 @@ def main():
     else:
         train, val = mnist_iters(args, flat, rank, num_workers)
 
-    if args.gpus:
+    if args.device == "cpu":
+        ctx = mx.cpu()
+    elif args.device == "tpu":
+        ctx = mx.tpu(0)            # raises if no chip is reachable
+    elif args.gpus:
         ctx = [mx.gpu(int(i)) for i in args.gpus.split(",")]
     else:
         ctx = mx.tpu(0) if mx.num_tpus() else mx.cpu()

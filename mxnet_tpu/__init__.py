@@ -25,6 +25,7 @@ from .attribute import AttrScope
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import engine
 from . import random
+from . import util
 from . import ndarray
 from . import ndarray as nd
 from . import autograd

@@ -20,5 +20,6 @@ from . import rnn_ops  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import rcnn_ops  # noqa: F401
 
 __all__ = ["registry", "register", "get", "list_all_ops", "OP_REGISTRY"]

@@ -1,0 +1,499 @@
+"""Faster-RCNN / FlowNet operator family.
+
+Reference: src/operator/contrib/proposal.cc (RPN Proposal),
+contrib/multi_proposal.cc (batched), contrib/deformable_convolution.cc +
+contrib/deformable_psroi_pooling.cu (Deformable ConvNets v1), and
+src/operator/correlation.cc (FlowNet correlation layer).
+
+TPU rebuild notes:
+- Proposal/MultiProposal are fixed-shape dataflow: anchor enumeration is
+  done at trace time (static), per-image filtering and greedy NMS are a
+  `lax.fori_loop` carrying a suppression mask over score-sorted
+  candidates, and the (post_nms_top_n, 5) output is filled by cycling
+  the kept rows exactly like the reference (`keep[i % out_size]`,
+  proposal.cc:414).
+- DeformableConvolution gathers bilinear samples for all kernel taps at
+  once (one vectorized gather) and contracts them against the weight
+  with a single einsum — the deformable-im2col + GEMM structure, with
+  the GEMM on the MXU and the gather left to XLA.
+- Correlation enumerates the (static) displacement grid in Python at
+  trace time; each displacement is an elementwise product of shifted
+  slices + a k×k window sum (`lax.reduce_window`) — no scalar loops,
+  and autodiff provides the backward pass the reference hand-writes.
+- Everything is differentiable through `jax.vjp` where the reference has
+  a backward (deformable ops, correlation); Proposal is marked
+  non-differentiable like the reference (its backward writes zeros).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .registry import register
+
+
+def _jx():
+    import jax
+
+    return jax, jax.numpy
+
+
+def _tuple2(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+# ---------------------------------------------------------------------------
+# anchor generation (legacy "+1" pixel conventions, proposal-inl.h:170-223)
+# ---------------------------------------------------------------------------
+
+def _generate_anchors(base_size, ratios, scales):
+    """(A, 4) base anchors; ratio-major, scale-minor enumeration to match
+    GenerateAnchors (proposal-inl.h:214-223). Legacy width = x2-x1+1."""
+    w = h = float(base_size)
+    x_ctr = 0.5 * (w - 1.0)
+    y_ctr = 0.5 * (h - 1.0)
+    size = w * h
+    out = []
+    for ratio in ratios:
+        size_ratio = math.floor(size / ratio)
+        new_w = math.floor(math.sqrt(size_ratio) + 0.5)
+        new_h = math.floor(new_w * ratio + 0.5)
+        for scale in scales:
+            sw, sh = new_w * scale, new_h * scale
+            out.append([x_ctr - 0.5 * (sw - 1.0), y_ctr - 0.5 * (sh - 1.0),
+                        x_ctr + 0.5 * (sw - 1.0), y_ctr + 0.5 * (sh - 1.0)])
+    return np.asarray(out, dtype=np.float32)
+
+
+def _proposal_one_image(jnp, lax, fg_scores, deltas, im_info, anchors,
+                        feature_stride, rpn_pre_nms_top_n,
+                        rpn_post_nms_top_n, threshold, rpn_min_size,
+                        iou_loss):
+    """Proposals for ONE image.
+
+    fg_scores: (A, H, W) foreground scores; deltas: (4A, H, W);
+    im_info: (3,) = (height, width, scale). Returns
+    (rois (post, 4), scores (post,)).
+    """
+    A = anchors.shape[0]
+    H, W = fg_scores.shape[1], fg_scores.shape[2]
+
+    # All shifted anchors, laid out h-major/w/a-minor like the
+    # reference's workspace (index = h*W*A + w*A + a, proposal.cc:348).
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    shifts = jnp.stack(
+        [jnp.tile(shift_x[None, :, None], (H, 1, A)),
+         jnp.tile(shift_y[:, None, None], (1, W, A)),
+         jnp.tile(shift_x[None, :, None], (H, 1, A)),
+         jnp.tile(shift_y[:, None, None], (1, W, A))], axis=-1)
+    boxes = jnp.asarray(anchors)[None, None, :, :] + shifts  # (H, W, A, 4)
+
+    # Bbox regression (BBoxTransformInv, proposal.cc:46-96; legacy
+    # "+1" width convention) or direct IoU offsets (IoUTransformInv).
+    d = jnp.transpose(deltas.reshape(A, 4, H, W), (2, 3, 0, 1))
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+    if iou_loss:
+        pred = boxes + d
+    else:
+        bw = boxes[..., 2] - boxes[..., 0] + 1.0
+        bh = boxes[..., 3] - boxes[..., 1] + 1.0
+        cx = boxes[..., 0] + 0.5 * (bw - 1.0)
+        cy = boxes[..., 1] + 0.5 * (bh - 1.0)
+        pcx = d[..., 0] * bw + cx
+        pcy = d[..., 1] * bh + cy
+        pw = jnp.exp(d[..., 2]) * bw
+        ph = jnp.exp(d[..., 3]) * bh
+        pred = jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                          pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                         axis=-1)
+    pred = jnp.clip(pred,
+                    jnp.zeros((4,), jnp.float32),
+                    jnp.stack([im_w - 1.0, im_h - 1.0,
+                               im_w - 1.0, im_h - 1.0]))
+
+    scores = jnp.transpose(fg_scores, (1, 2, 0))  # (H, W, A)
+    # Kill predictions from feature-map padding beyond the real image
+    # extent (proposal.cc:362-366: h >= real_height -> score -1).
+    real_h = jnp.floor(im_h / feature_stride)
+    real_w = jnp.floor(im_w / feature_stride)
+    hh = jnp.arange(H, dtype=jnp.float32)[:, None, None]
+    ww = jnp.arange(W, dtype=jnp.float32)[None, :, None]
+    scores = jnp.where((hh >= real_h) | (ww >= real_w), -1.0, scores)
+
+    # FilterBox (proposal.cc:145-158): too-small boxes are inflated by
+    # min_size/2 per side and score-killed.
+    min_size = rpn_min_size * im_scale
+    iw = pred[..., 2] - pred[..., 0] + 1.0
+    ih = pred[..., 3] - pred[..., 1] + 1.0
+    small = (iw < min_size) | (ih < min_size)
+    half = jnp.where(small, min_size / 2, 0.0)
+    grow = jnp.stack([-half, -half, half, half], axis=-1)
+    pred = pred + grow
+    scores = jnp.where(small, -1.0, scores)
+
+    flat_boxes = pred.reshape(-1, 4)
+    flat_scores = scores.reshape(-1)
+    count = flat_scores.shape[0]
+    n_pre = min(int(rpn_pre_nms_top_n), count)
+    n_post = min(int(rpn_post_nms_top_n), n_pre)
+
+    # stable descending sort by score, keep top pre_nms.
+    order = jnp.argsort(-flat_scores, stable=True)[:n_pre]
+    top_boxes = flat_boxes[order]
+    top_scores = flat_scores[order]
+
+    # Greedy NMS over the sorted list: fori_loop carries (suppressed,
+    # n_kept); a candidate is kept iff not suppressed and the quota of
+    # post_nms survivors is unfilled (NonMaximumSuppression,
+    # proposal.cc:213-260 — legacy +1 areas).
+    areas = (top_boxes[:, 2] - top_boxes[:, 0] + 1.0) * \
+            (top_boxes[:, 3] - top_boxes[:, 1] + 1.0)
+
+    def body(i, carry):
+        suppressed, kept, n_kept = carry
+        take = (~suppressed[i]) & (n_kept < n_post)
+        xx1 = jnp.maximum(top_boxes[i, 0], top_boxes[:, 0])
+        yy1 = jnp.maximum(top_boxes[i, 1], top_boxes[:, 1])
+        xx2 = jnp.minimum(top_boxes[i, 2], top_boxes[:, 2])
+        yy2 = jnp.minimum(top_boxes[i, 3], top_boxes[:, 3])
+        inter = jnp.maximum(xx2 - xx1 + 1.0, 0.0) * \
+            jnp.maximum(yy2 - yy1 + 1.0, 0.0)
+        iou = inter / (areas[i] + areas - inter)
+        kill = take & (iou > threshold) & (jnp.arange(n_pre) > i)
+        return (suppressed | kill, kept.at[i].set(take),
+                n_kept + take.astype(jnp.int32))
+
+    suppressed0 = jnp.zeros((n_pre,), bool)
+    kept0 = jnp.zeros((n_pre,), bool)
+    suppressed, kept, n_kept = lax.fori_loop(
+        0, n_pre, body, (suppressed0, kept0, jnp.int32(0)))
+
+    # Output rows cycle through the kept rows (proposal.cc:404-421:
+    # keep[i % out_size]).
+    kept_idx = jnp.flatnonzero(kept, size=n_post, fill_value=0)
+    out_size = jnp.maximum(n_kept, 1)
+    sel = kept_idx[jnp.arange(int(rpn_post_nms_top_n)) % out_size]
+    return top_boxes[sel], top_scores[sel]
+
+
+def _parse_floats(v, default):
+    if v is None:
+        return default
+    if isinstance(v, (list, tuple)):
+        return tuple(float(x) for x in v)
+    return (float(v),)
+
+
+def _proposal_impl(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                   rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                   scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+                   feature_stride=16, output_score=False, iou_loss=False):
+    jax, jnp = _jx()
+    from jax import lax
+
+    scales = _parse_floats(scales, (4.0, 8.0, 16.0, 32.0))
+    ratios = _parse_floats(ratios, (0.5, 1.0, 2.0))
+    anchors = _generate_anchors(int(feature_stride), ratios, scales)
+    A = anchors.shape[0]
+    n_batch = cls_prob.shape[0]
+
+    rois_all, scores_all = [], []
+    for n in range(n_batch):  # static batch unroll; vmap would forbid
+        # per-image dynamic im_info in the padding mask otherwise
+        rois, scr = _proposal_one_image(
+            jnp, lax, cls_prob[n, A:], bbox_pred[n], im_info[n], anchors,
+            float(feature_stride), int(rpn_pre_nms_top_n),
+            int(rpn_post_nms_top_n), float(threshold),
+            float(rpn_min_size), bool(iou_loss))
+        batch_col = jnp.full((rois.shape[0], 1), float(n), rois.dtype)
+        rois_all.append(jnp.concatenate([batch_col, rois], axis=1))
+        scores_all.append(scr[:, None])
+    rois = jnp.concatenate(rois_all, axis=0)
+    scores = jnp.concatenate(scores_all, axis=0)
+    if output_score:
+        return rois, scores
+    return rois
+
+
+@register("_contrib_Proposal", aliases=("Proposal", "_contrib_proposal"),
+          differentiable=False)
+def _proposal(cls_prob, bbox_pred, im_info, **kw):
+    """RPN proposals for a single image batch (proposal.cc). Inputs:
+    cls_prob (N, 2A, H, W) — first A channels background, last A
+    foreground; bbox_pred (N, 4A, H, W); im_info (N, 3) = (h, w, scale).
+    Output (N*post_nms_top_n, 5) rows = (batch_idx, x1, y1, x2, y2)."""
+    return _proposal_impl(cls_prob, bbox_pred, im_info, **kw)
+
+
+@register("_contrib_MultiProposal",
+          aliases=("MultiProposal", "_contrib_multi_proposal"),
+          differentiable=False)
+def _multi_proposal(cls_prob, bbox_pred, im_info, **kw):
+    """Batched Proposal (multi_proposal.cc) — same dataflow, every image
+    in the batch produces its own post_nms_top_n block of rois."""
+    return _proposal_impl(cls_prob, bbox_pred, im_info, **kw)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (contrib/deformable_convolution.cc)
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample_block(jnp, data_block, ys, xs):
+    """Bilinear sampling with the deformable-im2col border rule: a
+    sample is 0 when its center is outside (-1, H) x (-1, W); corner
+    taps outside the array contribute 0.
+
+    data_block: (C, H, W); ys/xs: (K, OH, OW) -> (C, K, OH, OW)."""
+    H, W = data_block.shape[1], data_block.shape[2]
+    valid = (ys > -1.0) & (ys < H) & (xs > -1.0) & (xs < W)
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yy = y0 + dy
+            xx = x0 + dx
+            tap_ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            vals = data_block[:, yi, xi]          # (C, K, OH, OW)
+            out = out + vals * (wy * wx * tap_ok)
+    return out * valid
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",
+                   "_contrib_deformable_convolution"))
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                            num_filter=1, num_group=1,
+                            num_deformable_group=1, no_bias=False,
+                            workspace=1024, layout=None):
+    """Deformable conv v1 (deformable_convolution.cc): each kernel tap
+    samples at its regular position plus a learned per-position offset.
+    offset layout (N, dg*2*kh*kw, OH, OW): within each deformable-group
+    block, channel 2*(i*kw+j) is the y-offset of tap (i, j), 2*(...)+1
+    the x-offset. Sampled taps contract against the weight in one
+    einsum (deformable-im2col + GEMM, on the MXU)."""
+    jax, jnp = _jx()
+    kh, kw = _tuple2(kernel)
+    sh, sw = _tuple2(stride)
+    dh, dw = _tuple2(dilate)
+    ph, pw = _tuple2(pad)
+    ng = int(num_group)
+    dg = int(num_deformable_group)
+    N, C, H, W = data.shape
+    OH = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    OW = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    K = kh * kw
+
+    # Regular grid positions per tap (K, OH, OW).
+    oy = jnp.arange(OH, dtype=jnp.float32)[None, :, None] * sh - ph
+    ox = jnp.arange(OW, dtype=jnp.float32)[None, None, :] * sw - pw
+    ki = jnp.arange(K, dtype=jnp.float32)[:, None, None]
+    base_y = oy + (ki // kw) * dh
+    base_x = ox + (ki % kw) * dw
+
+    off = offset.reshape(N, dg, K, 2, OH, OW)
+    ys = base_y[None, None] + off[:, :, :, 0]     # (N, dg, K, OH, OW)
+    xs = base_x[None, None] + off[:, :, :, 1]
+
+    dblk = data.reshape(N, dg, C // dg, H, W)
+    sample = jax.vmap(jax.vmap(_bilinear_sample_block, in_axes=(None, 0, 0, 0)),
+                      in_axes=(None, 0, 0, 0))(jnp, dblk, ys, xs)
+    # sample: (N, dg, C//dg, K, OH, OW) -> (N, C, K, OH, OW)
+    sample = sample.reshape(N, C, K, OH, OW)
+
+    F = int(num_filter)
+    wgt = weight.reshape(F, C // ng, K)
+    if ng == 1:
+        out = jnp.einsum("fck,nckhw->nfhw", wgt, sample,
+                         preferred_element_type=jnp.float32)
+        out = out.astype(data.dtype)
+    else:
+        outs = []
+        for g in range(ng):
+            outs.append(jnp.einsum(
+                "fck,nckhw->nfhw", wgt[g * (F // ng):(g + 1) * (F // ng)],
+                sample[:, g * (C // ng):(g + 1) * (C // ng)],
+                preferred_element_type=jnp.float32).astype(data.dtype))
+        out = jnp.concatenate(outs, axis=1)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# deformable PS-ROI pooling (contrib/deformable_psroi_pooling.cu:71-161)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",
+                   "_contrib_deformable_psroi_pooling"))
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=1, group_size=1, pooled_size=1,
+                              part_size=0, sample_per_part=1,
+                              trans_std=0.0, no_trans=False):
+    """Position-sensitive ROI pooling with learned per-part offsets
+    (deformable_psroi_pooling.cu:71-161; the reference's CPU path is
+    unimplemented — this is a full TPU implementation). data
+    (N, output_dim*group_size^2, H, W); rois (R, 5) =
+    (batch_idx, x1, y1, x2, y2); trans (R, 2*ncls, part, part).
+    Outputs (pooled (R, od, ps, ps), top_count) — two outputs like the
+    reference. One flat gather per bilinear corner; everything else is
+    broadcast arithmetic."""
+    jax, jnp = _jx()
+    ps = int(pooled_size)
+    gs = int(group_size)
+    od = int(output_dim)
+    spp = int(sample_per_part)
+    part = int(part_size) or ps
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    no_trans = bool(no_trans) or trans is None
+
+    batch_ind = rois[:, 0].astype(jnp.int32)                     # (R,)
+    # round() + legacy 0.5-shift (deformable_psroi_pooling.cu:99-102)
+    x1 = jnp.round(rois[:, 1]) * spatial_scale - 0.5
+    y1 = jnp.round(rois[:, 2]) * spatial_scale - 0.5
+    x2 = (jnp.round(rois[:, 3]) + 1.0) * spatial_scale - 0.5
+    y2 = (jnp.round(rois[:, 4]) + 1.0) * spatial_scale - 0.5
+    roi_w = jnp.maximum(x2 - x1, 0.1)                # force min 1x1 rois
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    bin_w, bin_h = roi_w / ps, roi_h / ps
+    sub_w, sub_h = bin_w / spp, bin_h / spp
+
+    pidx = jnp.arange(ps)
+    # part cell + group cell per pooled index (cu:115-116, 136-139).
+    part_of = jnp.floor(pidx.astype(jnp.float32) / ps * part).astype(jnp.int32)
+    g_of = jnp.clip((pidx * gs) // ps, 0, gs - 1)
+
+    # Learned offsets per (roi, output-channel, bin): (R, od, ps, ps).
+    if no_trans:
+        tx = ty = jnp.zeros((R, 1, 1, 1))
+    else:
+        ncls = trans.shape[1] // 2
+        cls_of = (jnp.arange(od) // max(od // ncls, 1)).astype(jnp.int32)
+        # trans[r, 2*cls+{0,1}, part_h, part_w] (cu:118-125)
+        tsel = trans[:, :, part_of][:, :, :, part_of]    # (R, 2ncls, ps, ps)
+        tx = tsel[:, 0::2][:, cls_of] * float(trans_std)
+        ty = tsel[:, 1::2][:, cls_of] * float(trans_std)
+
+    # Sample coordinates (R, od|1, ps(h), ps(w), spp(h), spp(w)).
+    ih = jnp.arange(spp, dtype=jnp.float32)
+    hstart = y1[:, None, None, None] + \
+        pidx[None, None, :, None] * bin_h[:, None, None, None] + \
+        ty * roi_h[:, None, None, None]
+    wstart = x1[:, None, None, None] + \
+        pidx[None, None, None, :] * bin_w[:, None, None, None] + \
+        tx * roi_w[:, None, None, None]
+    hh = hstart[..., None, None] + \
+        ih[:, None] * sub_h[:, None, None, None, None, None]
+    ww = wstart[..., None, None] + \
+        ih[None, :] * sub_w[:, None, None, None, None, None]
+    hh, ww = jnp.broadcast_arrays(hh, ww)
+
+    # Samples with center outside [-0.5, dim-0.5] are skipped (cu:147 —
+    # the borders themselves are inclusive).
+    vmask = (hh >= -0.5) & (hh <= H - 0.5) & (ww >= -0.5) & (ww <= W - 0.5)
+    hh = jnp.clip(hh, 0.0, H - 1.0)
+    ww = jnp.clip(ww, 0.0, W - 1.0)
+
+    # Position-sensitive channel c = (ctop*gs + gh)*gs + gw (cu:152).
+    chan = (jnp.arange(od)[:, None, None] * gs + g_of[None, :, None]) * gs \
+        + g_of[None, None, :]                                # (od, ps, ps)
+
+    # One flat gather per bilinear corner over the WHOLE batch buffer:
+    # idx = batch*C*H*W + chan*H*W + y*W + x. Folding batch_ind into
+    # the index avoids materializing a per-roi copy of each image's
+    # feature map ((R, C, H, W) would be GBs at R-FCN scale).
+    dflat = data.reshape(N * C * H * W)
+    noff = hh.shape[1]
+    spp2 = spp * spp
+    hh = hh.reshape(R, noff, ps, ps, spp2)
+    ww = ww.reshape(R, noff, ps, ps, spp2)
+    vm = vmask.reshape(R, noff, ps, ps, spp2)
+    if noff == 1:                           # broadcast offsets across od
+        hh = jnp.broadcast_to(hh, (R, od, ps, ps, spp2))
+        ww = jnp.broadcast_to(ww, (R, od, ps, ps, spp2))
+        vm = jnp.broadcast_to(vm, (R, od, ps, ps, spp2))
+    cbase = (batch_ind * (C * H * W))[:, None, None, None, None] \
+        + (chan * (H * W))[None, :, :, :, None]
+    h0 = jnp.floor(hh)
+    w0 = jnp.floor(ww)
+    ah, aw = hh - h0, ww - w0
+    val = 0.0
+    for dy, wy in ((0, 1.0 - ah), (1, ah)):
+        for dx, wx in ((0, 1.0 - aw), (1, aw)):
+            yi = jnp.clip(h0 + dy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(w0 + dx, 0, W - 1).astype(jnp.int32)
+            idx = cbase + yi * W + xi
+            corner = jnp.take(dflat, idx)
+            val = val + corner * (wy * wx)
+    val = val * vm
+    cnt = vm.sum(axis=4).astype(data.dtype)                 # (R, od, ps, ps)
+    pooled = jnp.where(cnt > 0, val.sum(axis=4) / jnp.maximum(cnt, 1.0), 0.0)
+    return pooled.astype(data.dtype), cnt
+
+
+# ---------------------------------------------------------------------------
+# correlation (src/operator/correlation.cc)
+# ---------------------------------------------------------------------------
+
+@register("Correlation", aliases=("_contrib_Correlation",))
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation (correlation.cc:41-82): for every output
+    position, correlate a k×k patch of data1 with patches of data2 at
+    every displacement in a (2*max_disp/stride2+1)^2 grid. The
+    displacement grid is static — enumerated at trace time as shifted
+    slices; each is a channel-summed product + k×k window sum."""
+    jax, jnp = _jx()
+    from jax import lax
+
+    k = int(kernel_size)
+    md = int(max_displacement)
+    s1 = int(stride1)
+    s2 = int(stride2)
+    pad = int(pad_size)
+    N, C, H, W = data1.shape
+    PH, PW = H + 2 * pad, W + 2 * pad
+    kr = (k - 1) // 2
+    border = md + kr
+    top_h = int(math.ceil(float(PH - 2 * border) / s1))
+    top_w = int(math.ceil(float(PW - 2 * border) / s1))
+    assert top_h >= 1 and top_w >= 1, \
+        "Correlation: neighborhood and kernel don't fit in the input"
+    grid_r = md // s2
+    grid_w = 2 * grid_r + 1
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    # data2 gets an extra md halo so every displacement is a static slice.
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad + md, pad + md),
+                         (pad + md, pad + md)))
+    sumelems = float(k * k * C)
+
+    chans = []
+    for tc in range(grid_w * grid_w):
+        s2o = (tc % grid_w - grid_r) * s2            # x displacement
+        s2p = (tc // grid_w - grid_r) * s2           # y displacement
+        q2 = lax.slice(p2, (0, 0, md + s2p, md + s2o),
+                       (N, C, md + s2p + PH, md + s2o + PW))
+        prod = p1 * q2 if is_multiply else jnp.abs(p1 - q2)
+        csum = prod.sum(axis=1)                      # (N, PH, PW)
+        if k > 1:
+            csum = lax.reduce_window(csum, 0.0, lax.add, (1, k, k),
+                                     (1, 1, 1), "valid")
+        # window top-left y1 = i*s1 + md; x1 = j*s1 + md
+        chans.append(lax.slice(
+            csum, (0, md, md),
+            (N, md + (top_h - 1) * s1 + 1, md + (top_w - 1) * s1 + 1),
+            (1, s1, s1)))
+    out = jnp.stack(chans, axis=1) / sumelems
+    return out.astype(data1.dtype)

@@ -5,7 +5,35 @@ import functools
 import os
 
 __all__ = ["makedirs", "get_gpu_count", "get_gpu_memory", "use_np_shape",
-           "is_np_shape", "set_np_shape"]
+           "is_np_shape", "set_np_shape", "pin_platform"]
+
+
+def pin_platform(choice):
+    """Honor a device choice IN-PROCESS, before the first backend touch.
+
+    `JAX_PLATFORMS=cpu` in the environment is not enough: the TPU PJRT
+    plugin re-registers itself at import time and overrides the env var,
+    so subprocesses pinning via env silently still dial the chip (and
+    hang when it is unreachable). `jax.config.update` wins over the
+    plugin as long as it runs before backend initialization.
+
+    choice: "auto" (no-op), "cpu", or "tpu". Anything else raises —
+    including values arriving via the MXNET_DEVICE env var, which
+    bypasses argparse `choices=` validation in the example drivers."""
+    if choice in (None, "auto"):
+        return
+    if choice not in ("cpu", "tpu"):
+        raise ValueError("pin_platform: unknown device %r "
+                         "(expected auto/cpu/tpu)" % (choice,))
+    if choice == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already up; nothing more we can do
+    # "tpu" keeps the default platform resolution (the axon plugin);
+    # drivers map it to mx.tpu(0) and fail loudly if no chip exists.
 
 _np_shape = [True]  # numpy-style zero-size shapes are native on jax
 

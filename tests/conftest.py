@@ -26,17 +26,26 @@ import pytest
 @pytest.fixture(autouse=True)
 def _seeded(request):
     """Reproducible-but-random seeds per test (reference:
-    tests/python/unittest/common.py @with_seed)."""
-    seed = np.random.randint(0, 2 ** 31)
+    tests/python/unittest/common.py @with_seed). An MXNET_TEST_SEED
+    env override reproduces a reported failure exactly."""
+    env_seed = os.environ.get("MXNET_TEST_SEED")
+    seed = int(env_seed) if env_seed else np.random.randint(0, 2 ** 31)
     np.random.seed(seed)
     import mxnet_tpu as mx
 
     mx.random.seed(seed)
     yield
-    # On failure pytest reports; seed printed for reproduction.
-    if request.node.rep_call.failed if hasattr(request.node, "rep_call") else False:
-        print("test seed:", seed)
+    # On failure print the seed for reproduction (MXNET_TEST_SEED=N).
+    rep = getattr(request.node, "rep_call", None)
+    if rep is not None and rep.failed:
+        print("\n*** test seed: %d (rerun with MXNET_TEST_SEED=%d) ***"
+              % (seed, seed))
 
 
+@pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
-    pass
+    """Attach the call-phase report to the item so the seed fixture can
+    see pass/fail (the non-wrapper form never populates rep_call)."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)

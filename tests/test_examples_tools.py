@@ -10,7 +10,10 @@ import numpy as np
 import pytest
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+# MXNET_DEVICE=cpu is honored IN-PROCESS by the drivers (jax.config
+# pin before backend init) — the plain JAX_PLATFORMS env var is
+# overridden by the TPU plugin and silently dials the chip.
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_DEVICE="cpu",
             XLA_FLAGS="--xla_force_host_platform_device_count=2")
 
 

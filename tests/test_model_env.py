@@ -17,7 +17,11 @@ def test_feedforward_fit_predict_score(tmp_path):
     rng = np.random.RandomState(0)
     X = rng.rand(120, 8).astype(np.float32)
     y = (X.sum(axis=1) > 4).astype(np.float32)
-    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=15,
+    # Uniform(0.01) default init + 60 adam updates is marginal for the
+    # 0.85 bar (fails ~40% of seeds); Xavier + 40 epochs trains clear of
+    # it while also exercising the initializer pass-through.
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=40,
+                                 initializer=mx.init.Xavier(),
                                  optimizer="adam", learning_rate=0.01)
     model.fit(mx.io.NDArrayIter(X, y, batch_size=30, shuffle=True,
                                 label_name="softmax_label"))

@@ -1,0 +1,581 @@
+"""Faster-RCNN op family: Proposal/MultiProposal, DeformableConvolution,
+DeformablePSROIPooling, Correlation — each checked against an
+independent numpy oracle that re-derives the reference semantics
+(src/operator/contrib/proposal.cc, deformable_psroi_pooling.cu,
+src/operator/correlation.cc), plus a tiny two-stage detector that
+converges on synthetic data (sibling of test_detection.py's tiny-SSD).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+# ---------------------------------------------------------------------------
+# anchors
+# ---------------------------------------------------------------------------
+
+def test_generate_anchors_classic_values():
+    """base 16, ratios (.5,1,2), scales (8,16,32) must reproduce the
+    canonical py-faster-rcnn table (proposal-inl.h:170-223 math)."""
+    from mxnet_tpu.ops.rcnn_ops import _generate_anchors
+
+    got = _generate_anchors(16, (0.5, 1.0, 2.0), (8.0, 16.0, 32.0))
+    want = np.array([
+        [-84., -40., 99., 55.], [-176., -88., 191., 103.],
+        [-360., -184., 375., 199.], [-56., -56., 71., 71.],
+        [-120., -120., 135., 135.], [-248., -248., 263., 263.],
+        [-36., -80., 51., 95.], [-80., -168., 95., 183.],
+        [-168., -344., 183., 359.]], np.float32)
+    np.testing.assert_allclose(got, want)
+
+
+# ---------------------------------------------------------------------------
+# Proposal — numpy oracle re-deriving proposal.cc
+# ---------------------------------------------------------------------------
+
+def _np_proposal(cls_prob, bbox_pred, im_info, anchors, stride, pre_nms,
+                 post_nms, thresh, min_size):
+    """Single-image oracle following proposal.cc step by step."""
+    A = anchors.shape[0]
+    H, W = cls_prob.shape[2], cls_prob.shape[3]
+    scores = np.transpose(cls_prob[0, A:], (1, 2, 0)).reshape(-1).copy()
+    deltas = np.transpose(bbox_pred[0].reshape(A, 4, H, W),
+                          (2, 3, 0, 1)).reshape(-1, 4)
+    shifts = np.stack(np.meshgrid(np.arange(W) * stride,
+                                  np.arange(H) * stride), -1)  # (H,W,2) x,y
+    boxes = (anchors[None, None] + np.concatenate(
+        [shifts, shifts], -1)[:, :, None].transpose(0, 1, 2, 3)).reshape(-1, 4)
+    im_h, im_w, im_scale = im_info
+    bw = boxes[:, 2] - boxes[:, 0] + 1
+    bh = boxes[:, 3] - boxes[:, 1] + 1
+    cx = boxes[:, 0] + 0.5 * (bw - 1)
+    cy = boxes[:, 1] + 0.5 * (bh - 1)
+    pcx = deltas[:, 0] * bw + cx
+    pcy = deltas[:, 1] * bh + cy
+    pw = np.exp(deltas[:, 2]) * bw
+    ph = np.exp(deltas[:, 3]) * bh
+    pred = np.stack([pcx - 0.5 * (pw - 1), pcy - 0.5 * (ph - 1),
+                     pcx + 0.5 * (pw - 1), pcy + 0.5 * (ph - 1)], -1)
+    pred[:, 0::2] = np.clip(pred[:, 0::2], 0, im_w - 1)
+    pred[:, 1::2] = np.clip(pred[:, 1::2], 0, im_h - 1)
+    real_h, real_w = int(im_h / stride), int(im_w / stride)
+    hh = np.repeat(np.arange(H), W * A)
+    ww = np.tile(np.repeat(np.arange(W), A), H)
+    scores[(hh >= real_h) | (ww >= real_w)] = -1
+    ms = min_size * im_scale
+    iw = pred[:, 2] - pred[:, 0] + 1
+    ih = pred[:, 3] - pred[:, 1] + 1
+    small = (iw < ms) | (ih < ms)
+    pred[small, 0] -= ms / 2
+    pred[small, 1] -= ms / 2
+    pred[small, 2] += ms / 2
+    pred[small, 3] += ms / 2
+    scores[small] = -1
+    order = np.argsort(-scores, kind="stable")[:pre_nms]
+    dets = np.concatenate([pred[order], scores[order, None]], 1)
+    areas = (dets[:, 2] - dets[:, 0] + 1) * (dets[:, 3] - dets[:, 1] + 1)
+    suppressed = np.zeros(len(dets), bool)
+    keep = []
+    for i in range(len(dets)):
+        if len(keep) >= min(post_nms, pre_nms):
+            break
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(dets[i, 0], dets[i + 1:, 0])
+        yy1 = np.maximum(dets[i, 1], dets[i + 1:, 1])
+        xx2 = np.minimum(dets[i, 2], dets[i + 1:, 2])
+        yy2 = np.minimum(dets[i, 3], dets[i + 1:, 3])
+        inter = np.maximum(xx2 - xx1 + 1, 0) * np.maximum(yy2 - yy1 + 1, 0)
+        iou = inter / (areas[i] + areas[i + 1:] - inter)
+        suppressed[i + 1:] |= iou > thresh
+    out = np.zeros((post_nms, 5), np.float32)
+    scr = np.zeros((post_nms,), np.float32)
+    for i in range(post_nms):
+        j = keep[i % len(keep)]
+        out[i, 1:] = dets[j, :4]
+        scr[i] = dets[j, 4]
+    return out, scr
+
+
+def test_proposal_matches_numpy_oracle():
+    from mxnet_tpu.ops.rcnn_ops import _generate_anchors
+
+    rng = np.random.RandomState(7)
+    A, H, W, stride = 3, 6, 7, 8
+    scales, ratios = (2.0, 4.0, 8.0), (1.0,)
+    cls_prob = rng.rand(1, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (rng.randn(1, 4 * A, H, W) * 0.3).astype(np.float32)
+    im_info = np.array([[44.0, 52.0, 1.0]], np.float32)
+
+    rois, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+        mx.nd.array(im_info), rpn_pre_nms_top_n=40, rpn_post_nms_top_n=12,
+        threshold=0.7, rpn_min_size=4, scales=scales, ratios=ratios,
+        feature_stride=stride, output_score=True)
+
+    anchors = _generate_anchors(stride, ratios, scales)
+    want, want_s = _np_proposal(cls_prob, bbox_pred, im_info[0], anchors,
+                                stride, 40, 12, 0.7, 4)
+    np.testing.assert_allclose(rois.asnumpy(), want, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(scores.asnumpy().ravel(), want_s,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multi_proposal_batches():
+    from mxnet_tpu.ops.rcnn_ops import _generate_anchors
+
+    rng = np.random.RandomState(3)
+    A, H, W, stride = 2, 5, 5, 16
+    scales, ratios = (4.0, 8.0), (1.0,)
+    cls_prob = rng.rand(2, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (rng.randn(2, 4 * A, H, W) * 0.2).astype(np.float32)
+    im_info = np.array([[70.0, 70.0, 1.0], [60.0, 76.0, 1.2]], np.float32)
+
+    rois = mx.nd.contrib.MultiProposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8, threshold=0.6,
+        rpn_min_size=8, scales=scales, ratios=ratios,
+        feature_stride=stride).asnumpy()
+    assert rois.shape == (16, 5)
+    anchors = _generate_anchors(stride, ratios, scales)
+    for n in range(2):
+        want, _ = _np_proposal(cls_prob[n:n + 1], bbox_pred[n:n + 1],
+                               im_info[n], anchors, stride, 30, 8, 0.6, 8)
+        blk = rois[n * 8:(n + 1) * 8]
+        assert np.all(blk[:, 0] == n)
+        np.testing.assert_allclose(blk[:, 1:], want[:, 1:],
+                                   rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Correlation — numpy oracle re-deriving correlation.cc:41-82
+# ---------------------------------------------------------------------------
+
+def _np_correlation(d1, d2, k, md, s1, s2, pad, is_mult):
+    N, C, H, W = d1.shape
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    PH, PW = H + 2 * pad, W + 2 * pad
+    kr = (k - 1) // 2
+    border = md + kr
+    th = int(np.ceil((PH - 2 * border) / s1))
+    tw = int(np.ceil((PW - 2 * border) / s1))
+    gr = md // s2
+    gw = 2 * gr + 1
+    out = np.zeros((N, gw * gw, th, tw), np.float32)
+    for i in range(th):
+        for j in range(tw):
+            x1, y1 = j * s1 + md, i * s1 + md
+            for tc in range(gw * gw):
+                s2o = (tc % gw - gr) * s2
+                s2p = (tc // gw - gr) * s2
+                x2, y2 = x1 + s2o, y1 + s2p
+                a = p1[:, :, y1:y1 + k, x1:x1 + k]
+                # displacement windows never cross the padded border
+                b = p2[:, :, y2:y2 + k, x2:x2 + k]
+                v = a * b if is_mult else np.abs(a - b)
+                out[:, tc, i, j] = v.sum(axis=(1, 2, 3))
+    return out / (k * k * C)
+
+
+@pytest.mark.parametrize("k,md,s1,s2,pad,mult", [
+    (1, 2, 1, 1, 2, True),
+    (1, 2, 1, 2, 2, True),
+    (3, 2, 2, 1, 3, True),
+    (1, 1, 1, 1, 1, False),
+])
+def test_correlation_matches_numpy(k, md, s1, s2, pad, mult):
+    rng = np.random.RandomState(11)
+    d1 = rng.randn(2, 3, 8, 9).astype(np.float32)
+    d2 = rng.randn(2, 3, 8, 9).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2),
+                            kernel_size=k, max_displacement=md,
+                            stride1=s1, stride2=s2, pad_size=pad,
+                            is_multiply=mult).asnumpy()
+    want = _np_correlation(d1, d2, k, md, s1, s2, pad, mult)
+    assert out.shape == want.shape
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_gradient_flows():
+    d1 = mx.nd.array(np.random.RandomState(0).randn(1, 2, 6, 6)
+                     .astype(np.float32))
+    d2 = mx.nd.array(np.random.RandomState(1).randn(1, 2, 6, 6)
+                     .astype(np.float32))
+    d1.attach_grad()
+    d2.attach_grad()
+    with autograd.record():
+        out = mx.nd.Correlation(d1, d2, kernel_size=1, max_displacement=1,
+                                pad_size=1)
+        loss = (out * out).sum()
+    loss.backward()
+    assert float(mx.nd.abs(d1.grad).sum().asnumpy()) > 0
+    assert float(mx.nd.abs(d2.grad).sum().asnumpy()) > 0
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_is_conv():
+    """With zero offsets the op must equal a regular Convolution."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    w = (rng.randn(6, 4, 3, 3) * 0.2).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    got = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w), mx.nd.array(b),
+        kernel=(3, 3), num_filter=6).asnumpy()
+    want = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                             mx.nd.array(b), kernel=(3, 3),
+                             num_filter=6).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """A constant integer offset of (0, +1) samples one pixel right —
+    identical to convolving the shifted image (interior pixels)."""
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    w = (rng.randn(3, 2, 3, 3) * 0.3).astype(np.float32)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    off[:, 1::2] = 1.0                      # x-offset channels
+    got = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        kernel=(3, 3), num_filter=3, no_bias=True).asnumpy()
+    xs = np.roll(x, -1, axis=3)             # shift left = sample right
+    want = mx.nd.Convolution(mx.nd.array(xs), mx.nd.array(w), None,
+                             kernel=(3, 3), num_filter=3,
+                             no_bias=True).asnumpy()
+    np.testing.assert_allclose(got[:, :, :, :5], want[:, :, :, :5],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_pad_stride_groups():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    w = (rng.randn(4, 4, 3, 3) * 0.2).astype(np.float32)
+    off = np.zeros((2, 2 * 2 * 9, 4, 4), np.float32)  # 2 deformable groups
+    got = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w),
+        kernel=(3, 3), stride=(2, 2), pad=(1, 1), num_filter=4,
+        num_deformable_group=2, no_bias=True).asnumpy()
+    want = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), None,
+                             kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                             num_filter=4, no_bias=True).asnumpy()
+    assert got.shape == want.shape == (2, 4, 4, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_gradient():
+    """Numeric gradient of a scalar loss w.r.t. offsets (the deformable
+    part) — checks the bilinear-sampling backward path."""
+    rng = np.random.RandomState(9)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = (rng.randn(2, 2, 3, 3) * 0.4).astype(np.float32)
+    # offsets in [0.05, 0.35]: far enough from integer sampling points
+    # that the eps=1e-2 finite difference never crosses a bilinear kink
+    off0 = (rng.rand(1, 18, 3, 3) * 0.3 + 0.05).astype(np.float32)
+
+    def loss_of(offv):
+        out = mx.nd.contrib.DeformableConvolution(
+            mx.nd.array(x), mx.nd.array(offv), mx.nd.array(w),
+            kernel=(3, 3), num_filter=2, no_bias=True)
+        return float((out * out).sum().asnumpy())
+
+    off = mx.nd.array(off0)
+    off.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.DeformableConvolution(
+            mx.nd.array(x), off, mx.nd.array(w),
+            kernel=(3, 3), num_filter=2, no_bias=True)
+        loss = (out * out).sum()
+    loss.backward()
+    g = off.grad.asnumpy()
+    eps = 1e-2
+    for idx in [(0, 0, 1, 1), (0, 5, 2, 0), (0, 17, 0, 2)]:
+        pert = off0.copy()
+        pert[idx] += eps
+        up = loss_of(pert)
+        pert[idx] -= 2 * eps
+        dn = loss_of(pert)
+        num = (up - dn) / (2 * eps)
+        assert abs(num - g[idx]) < 2e-2 + 0.05 * abs(num), \
+            "offset grad mismatch at %s: %f vs %f" % (idx, g[idx], num)
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling — numpy oracle re-deriving the CUDA kernel
+# ---------------------------------------------------------------------------
+
+def _np_psroi(data, rois, trans, scale, od, gs, ps, part, spp, tstd,
+              no_trans):
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    ceach = max(od // ncls, 1)
+    out = np.zeros((R, od, ps, ps), np.float32)
+    cnt = np.zeros((R, od, ps, ps), np.float32)
+    for n in range(R):
+        bi = int(rois[n, 0])
+        x1 = round(rois[n, 1]) * scale - 0.5
+        y1 = round(rois[n, 2]) * scale - 0.5
+        x2 = (round(rois[n, 3]) + 1.0) * scale - 0.5
+        y2 = (round(rois[n, 4]) + 1.0) * scale - 0.5
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / ps, rw / ps
+        sh, sw = bh / spp, bw / spp
+        for ctop in range(od):
+            for phh in range(ps):
+                for pww in range(ps):
+                    ph_ = int(np.floor(phh / ps * part))
+                    pw_ = int(np.floor(pww / ps * part))
+                    cid = ctop // ceach
+                    tx = 0.0 if no_trans else \
+                        trans[n, cid * 2, ph_, pw_] * tstd
+                    ty = 0.0 if no_trans else \
+                        trans[n, cid * 2 + 1, ph_, pw_] * tstd
+                    ws = pww * bw + x1 + tx * rw
+                    hs = phh * bh + y1 + ty * rh
+                    gw = min(max(int(pww * gs // ps), 0), gs - 1)
+                    gh = min(max(int(phh * gs // ps), 0), gs - 1)
+                    c = (ctop * gs + gh) * gs + gw
+                    s = 0.0
+                    k = 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w_ = ws + iw * sw
+                            h_ = hs + ih * sh
+                            if w_ < -0.5 or w_ > W - 0.5 or \
+                               h_ < -0.5 or h_ > H - 0.5:
+                                continue
+                            w_ = min(max(w_, 0.0), W - 1.0)
+                            h_ = min(max(h_, 0.0), H - 1.0)
+                            h0, w0 = int(h_), int(w_)
+                            h1, w1 = min(h0 + 1, H - 1), min(w0 + 1, W - 1)
+                            dh, dw = h_ - h0, w_ - w0
+                            v = (data[bi, c, h0, w0] * (1 - dh) * (1 - dw)
+                                 + data[bi, c, h0, w1] * (1 - dh) * dw
+                                 + data[bi, c, h1, w0] * dh * (1 - dw)
+                                 + data[bi, c, h1, w1] * dh * dw)
+                            s += v
+                            k += 1
+                    out[n, ctop, phh, pww] = 0.0 if k == 0 else s / k
+                    cnt[n, ctop, phh, pww] = k
+    return out, cnt
+
+
+@pytest.mark.parametrize("no_trans", [True, False])
+def test_deformable_psroi_matches_numpy(no_trans):
+    rng = np.random.RandomState(13)
+    od, gs, ps, part, spp = 3, 2, 4, 4, 2
+    data = rng.randn(2, od * gs * gs, 10, 10).astype(np.float32)
+    rois = np.array([[0, 2, 2, 7, 8], [1, 0, 1, 9, 9],
+                     [0, 4, 4, 5, 5]], np.float32)
+    trans = (rng.rand(3, 2, part, part).astype(np.float32) - 0.5)
+    args = [mx.nd.array(data), mx.nd.array(rois)]
+    kw = dict(spatial_scale=0.8, output_dim=od, group_size=gs,
+              pooled_size=ps, part_size=part, sample_per_part=spp,
+              trans_std=0.3, no_trans=no_trans)
+    if not no_trans:
+        args.append(mx.nd.array(trans))
+    got, got_cnt = mx.nd.contrib.DeformablePSROIPooling(*args, **kw)
+    want, want_cnt = _np_psroi(data, rois, trans, 0.8, od, gs, ps, part,
+                               spp, 0.3, no_trans)
+    np.testing.assert_allclose(got_cnt.asnumpy(), want_cnt)
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_psroi_gradient_flows():
+    rng = np.random.RandomState(14)
+    data = mx.nd.array(rng.randn(1, 4, 8, 8).astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 1, 1, 6, 6]], np.float32))
+    trans = mx.nd.array((rng.rand(1, 2, 2, 2) * 0.2).astype(np.float32))
+    data.attach_grad()
+    trans.attach_grad()
+    with autograd.record():
+        out, _ = mx.nd.contrib.DeformablePSROIPooling(
+            data, rois, trans, spatial_scale=1.0, output_dim=1,
+            group_size=2, pooled_size=2, part_size=2, sample_per_part=2,
+            trans_std=0.5)
+        loss = (out * out).sum()
+    loss.backward()
+    assert float(mx.nd.abs(data.grad).sum().asnumpy()) > 0
+    assert float(mx.nd.abs(trans.grad).sum().asnumpy()) > 0
+
+
+# ---------------------------------------------------------------------------
+# tiny two-stage detector (RPN + Proposal + ROIAlign head)
+# ---------------------------------------------------------------------------
+
+class TinyRPN(gluon.HybridBlock):
+    """Conv trunk (stride 4) + RPN heads; A=1 anchor per position."""
+
+    def __init__(self):
+        super().__init__()
+        self.trunk = gluon.nn.HybridSequential()
+        self.trunk.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+                       gluon.nn.MaxPool2D(2),
+                       gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+                       gluon.nn.MaxPool2D(2))
+        self.register_child(self.trunk)
+        self.cls = gluon.nn.Conv2D(2, 1)    # 2A channels, A=1
+        self.loc = gluon.nn.Conv2D(4, 1)    # 4A channels
+        self.register_child(self.cls)
+        self.register_child(self.loc)
+
+    def hybrid_forward(self, F, x):
+        feat = self.trunk(x)
+        return feat, self.cls(feat), self.loc(feat)
+
+
+def _make_rcnn_data(n, rng):
+    """16x16 images with one 6x6 bright square; two classes by texture:
+    class 0 = solid, class 1 = striped."""
+    X = (rng.rand(n, 1, 16, 16) * 0.2).astype(np.float32)
+    boxes = np.zeros((n, 4), np.float32)
+    cls = np.zeros((n,), np.int64)
+    for i in range(n):
+        r, c = rng.randint(0, 10, 2)
+        cls[i] = rng.randint(0, 2)
+        patch = np.ones((6, 6), np.float32)
+        if cls[i] == 1:
+            patch[::2] = 0.25
+        X[i, 0, r:r + 6, c:c + 6] += patch
+        boxes[i] = [c, r, c + 5, r + 5]     # pixel corners
+    return X, boxes, cls
+
+
+def test_tiny_faster_rcnn_converges():
+    """Two-stage pipeline end-to-end: RPN trains binary
+    objectness + bbox deltas; Proposal decodes rois; ROIAlign + dense
+    head classifies the texture class. Training drives both losses
+    down and the final proposals localize the object."""
+    rng = np.random.RandomState(0)
+    n = 48
+    X, gt_boxes, gt_cls = _make_rcnn_data(n, rng)
+    stride, A = 4, 1
+
+    from mxnet_tpu.ops.rcnn_ops import _generate_anchors
+
+    anchors = _generate_anchors(stride, (1.0,), (1.5,))   # one 6x6-ish
+    H = W = 16 // stride
+    shifts_x = np.arange(W) * stride
+    shifts_y = np.arange(H) * stride
+    all_anchors = (anchors[None, None] + np.stack(
+        [np.tile(shifts_x, (H, 1)), np.tile(shifts_y[:, None], (1, W)),
+         np.tile(shifts_x, (H, 1)), np.tile(shifts_y[:, None], (1, W))],
+        -1)[:, :, None]).reshape(-1, 4)                   # (H*W*A, 4)
+
+    # RPN targets: positive = IoU > 0.5 with gt
+    def iou_with(gt):
+        x1 = np.maximum(all_anchors[:, 0], gt[0])
+        y1 = np.maximum(all_anchors[:, 1], gt[1])
+        x2 = np.minimum(all_anchors[:, 2], gt[2])
+        y2 = np.minimum(all_anchors[:, 3], gt[3])
+        inter = np.maximum(x2 - x1 + 1, 0) * np.maximum(y2 - y1 + 1, 0)
+        aa = (all_anchors[:, 2] - all_anchors[:, 0] + 1) * \
+             (all_anchors[:, 3] - all_anchors[:, 1] + 1)
+        ab = (gt[2] - gt[0] + 1) * (gt[3] - gt[1] + 1)
+        return inter / (aa + ab - inter)
+
+    cls_t = np.zeros((n, H * W * A), np.float32)
+    loc_t = np.zeros((n, H * W * A, 4), np.float32)
+    loc_m = np.zeros((n, H * W * A, 1), np.float32)
+    for i in range(n):
+        ious = iou_with(gt_boxes[i])
+        # best anchor is always positive; others need IoU >= 0.35
+        pos = ious >= min(0.35, ious.max() - 1e-6)
+        cls_t[i, pos] = 1
+        aw = all_anchors[:, 2] - all_anchors[:, 0] + 1
+        ah = all_anchors[:, 3] - all_anchors[:, 1] + 1
+        acx = all_anchors[:, 0] + 0.5 * (aw - 1)
+        acy = all_anchors[:, 1] + 0.5 * (ah - 1)
+        gw = gt_boxes[i, 2] - gt_boxes[i, 0] + 1
+        gh = gt_boxes[i, 3] - gt_boxes[i, 1] + 1
+        gcx = gt_boxes[i, 0] + 0.5 * (gw - 1)
+        gcy = gt_boxes[i, 1] + 0.5 * (gh - 1)
+        loc_t[i, :, 0] = (gcx - acx) / aw
+        loc_t[i, :, 1] = (gcy - acy) / ah
+        loc_t[i, :, 2] = np.log(gw / aw)
+        loc_t[i, :, 3] = np.log(gh / ah)
+        loc_m[i, pos] = 1
+
+    net = TinyRPN()
+    head = gluon.nn.HybridSequential()
+    head.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    head.initialize()
+    params = list(net.collect_params().values()) + \
+        list(head.collect_params().values())
+    trainer = gluon.Trainer({p.name: p for p in params}, "adam",
+                            {"learning_rate": 0.01})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(X)
+    ct = mx.nd.array(cls_t)
+    lt = mx.nd.array(loc_t.reshape(n, -1))
+    lm = mx.nd.array(np.repeat(loc_m, 4, axis=2).reshape(n, -1))
+    ycls = mx.nd.array(gt_cls.astype(np.float32))
+    im_info = mx.nd.array(np.tile([16.0, 16.0, 1.0], (n, 1)))
+    gt_rois = np.concatenate(
+        [np.arange(n, dtype=np.float32)[:, None],
+         gt_boxes / stride], axis=1)        # feature-map coords
+    gt_rois_nd = mx.nd.array(gt_rois)
+
+    first = last = None
+    for it in range(60):
+        with autograd.record():
+            feat, rpn_cls, rpn_loc = net(x)
+            rc = rpn_cls.transpose((0, 2, 3, 1)).reshape((-1, 2))
+            cls_loss = ce(rc, ct.reshape((-1,))).mean()
+            diff = (rpn_loc.transpose((0, 2, 3, 1)).reshape((n, -1)) - lt) \
+                * lm
+            loc_loss = (diff * diff).sum() / mx.nd.maximum(
+                lm.sum(), mx.nd.array([1.0]))
+            # stage 2: head trains on ground-truth rois (standard
+            # alternating scheme; proposals are used at inference)
+            pooled = mx.nd.contrib.ROIAlign(
+                feat, gt_rois_nd, pooled_size=(3, 3), spatial_scale=1.0)
+            head_loss = ce(head(pooled.reshape((n, -1))), ycls).mean()
+            loss = cls_loss + 0.5 * loc_loss + head_loss
+        loss.backward()
+        trainer.step(n)
+        last = float(loss.asnumpy().ravel()[0])
+        if first is None:
+            first = last
+    assert last < first * 0.5, "rcnn loss %.4f -> %.4f" % (first, last)
+
+    # inference through Proposal: objectness softmax over 2A channels
+    feat, rpn_cls, rpn_loc = net(x)
+    probs = rpn_cls.reshape((n, 2, -1)).softmax(axis=1).reshape(
+        (n, 2, H, W))
+    rois = mx.nd.contrib.MultiProposal(
+        probs, rpn_loc, im_info, rpn_pre_nms_top_n=16,
+        rpn_post_nms_top_n=1, threshold=0.7, rpn_min_size=2,
+        scales=(1.5,), ratios=(1.0,), feature_stride=stride).asnumpy()
+    hits = 0
+    cls_hits = 0
+    pooled = mx.nd.contrib.ROIAlign(
+        feat, mx.nd.array(np.concatenate(
+            [rois[:, :1], rois[:, 1:] / stride], axis=1)),
+        pooled_size=(3, 3), spatial_scale=1.0)
+    pred_cls = head(pooled.reshape((n, -1))).asnumpy().argmax(axis=1)
+    for i in range(n):
+        x1 = max(rois[i, 1], gt_boxes[i, 0])
+        y1 = max(rois[i, 2], gt_boxes[i, 1])
+        x2 = min(rois[i, 3], gt_boxes[i, 2])
+        y2 = min(rois[i, 4], gt_boxes[i, 3])
+        inter = max(x2 - x1 + 1, 0) * max(y2 - y1 + 1, 0)
+        ra = (rois[i, 3] - rois[i, 1] + 1) * (rois[i, 4] - rois[i, 2] + 1)
+        ga = 36.0
+        if inter / (ra + ga - inter) > 0.3:
+            hits += 1
+        if pred_cls[i] == gt_cls[i]:
+            cls_hits += 1
+    assert hits >= n * 0.7, "proposal localization %d/%d" % (hits, n)
+    assert cls_hits >= n * 0.8, "head accuracy %d/%d" % (cls_hits, n)
