@@ -2,19 +2,22 @@
 
 Reference: python/mxnet/contrib/ (io, quantization, text, onnx,
 tensorrt, svrg_optimization, tensorboard, autograd). Present here:
-``io`` (DataLoaderIter) and ``quantization`` (INT8 calibration). ONNX /
-TensorRT / tensorboard bridges target CUDA-ecosystem tooling and are
-out of scope for the TPU build (export via `HybridBlock.export` +
-jax2tf/StableHLO is the TPU-native serving path).
+``io`` (DataLoaderIter), ``quantization`` (INT8 calibration), ``text``
+(vocabulary + token embeddings), ``svrg_optimization`` (SVRGModule).
+ONNX / TensorRT / tensorboard bridges target CUDA-ecosystem tooling and
+are out of scope for the TPU build (export via `HybridBlock.export` +
+StableHLO is the TPU-native serving path).
 """
 from . import io  # noqa: F401
 
+_LAZY = ("quantization", "text", "svrg_optimization")
+
 
 def __getattr__(name):
-    if name == "quantization":
+    if name in _LAZY:
         import importlib
 
-        mod = importlib.import_module(".quantization", __name__)
+        mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod
         return mod
     raise AttributeError("mx.contrib has no attribute %r" % name)

@@ -1,0 +1,6 @@
+"""SVRG optimization (reference:
+python/mxnet/contrib/svrg_optimization/__init__.py)."""
+from .svrg_module import SVRGModule
+from .svrg_optimizer import _AssignmentOptimizer, _SVRGOptimizer
+
+__all__ = ["SVRGModule", "_AssignmentOptimizer", "_SVRGOptimizer"]

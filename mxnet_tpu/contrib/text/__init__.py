@@ -1,0 +1,8 @@
+"""Text processing utilities (reference:
+python/mxnet/contrib/text/__init__.py — vocab, embedding, utils)."""
+from . import embedding
+from . import utils
+from . import vocab
+from .vocab import Vocabulary
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary"]

@@ -259,6 +259,19 @@ class HybridBlock(Block):
             return {k: p.data(ctx) for k, p in self._reg_params.items()}
 
     def forward(self, x, *args):
+        from .. import symbol as _sym
+
+        if isinstance(x, _sym.Symbol):
+            # Symbolic re-trace (export path): parameters become named
+            # variables so the graph serializes with stable arg names
+            # (reference block.py:_get_graph traces with F=symbol).
+            # Aux-ness (BatchNorm moving stats) is assigned by the op
+            # composition from the op signature — NOT from grad_req,
+            # which would misfile frozen weights as aux.
+            params = {k: _sym.Symbol(None, name=p.name)
+                      for k, p in self._reg_params.items()}
+            return self.hybrid_forward(_sym, x, *args, **params)
+        self._num_forward_inputs = 1 + len(args)
         params = self._ensure_init(x, *args)
         return self.hybrid_forward(nd, x, *args, **params)
 
@@ -334,7 +347,10 @@ class HybridBlock(Block):
         return out
 
     def __call__(self, *args, **kwargs):
+        from ..symbol import Symbol as _Symbol
+
         if self._active and tracing_overrides() is None and \
+                not any(isinstance(a, _Symbol) for a in args) and \
                 not any(isinstance(a, NDArray) and _is_traced_nd(a) for a in args):
             for hook in self._forward_pre_hooks:
                 hook(self, args)
@@ -345,9 +361,73 @@ class HybridBlock(Block):
         return super().__call__(*args, **kwargs)
 
     def export(self, path, epoch=0):
-        """Reference: HybridBlock.export writes json+params. We export the
-        parameter file; graph export arrives with the Symbol layer."""
-        self.save_parameters("%s-%04d.params" % (path, epoch))
+        """Write ``path-symbol.json`` + ``path-%04d.params`` (reference
+        block.py:export :1008): the block is re-traced through the
+        Symbol frontend in inference mode and the graph serialized; the
+        params file uses the reference's ``arg:``/``aux:``-prefixed
+        checkpoint format so ``SymbolBlock.imports`` (and the reference
+        itself) can reload it. Parameters must be initialized (call the
+        block once first). The exported graph is an inference graph.
+
+        Returns (symbol_filename, params_filename)."""
+        from .. import symbol as _sym
+
+        n_in = getattr(self, "_num_forward_inputs", 1)
+        names = ["data"] if n_in == 1 else \
+            ["data%d" % i for i in range(n_in)]
+        ins = [_sym.var(n) for n in names]
+        with autograd.pause(train_mode=False):
+            out = self(*ins)
+        if isinstance(out, (list, tuple)):
+            out = _sym.Group(list(out))
+        sym_file = "%s-symbol.json" % path
+        out.save(sym_file)
+
+        arg_names = set(out.list_arguments())
+        aux_names = set(out.list_auxiliary_states())
+        save_dict = {}
+        for p in self.collect_params().values():
+            if p._data is None:
+                continue
+            kind = "aux" if p.name in aux_names else "arg"
+            if p.name in arg_names or p.name in aux_names:
+                save_dict["%s:%s" % (kind, p.name)] = p.data()
+        params_file = "%s-%04d.params" % (path, epoch)
+        nd.save(params_file, save_dict)
+        return sym_file, params_file
+
+    def export_stablehlo(self, path, *example_inputs):
+        """Serialize the jitted inference computation as a portable
+        StableHLO artifact via ``jax.export`` — loadable and runnable
+        with plain jax, no mxnet_tpu required (the TPU analogue of the
+        reference's deployment exports through the C predict API).
+
+        Writes ``path.stablehlo`` and returns its filename."""
+        import jax
+        from jax import export as jexport
+        import jax.numpy as jnp
+
+        param_objs = list(self.collect_params().values())
+        pvals = {p.name: p.data()._data for p in param_objs}
+
+        def fn(*xs):
+            # params are closure constants: the artifact is
+            # self-contained (weights embedded in the StableHLO module).
+            mapping = {p: NDArray(pvals[p.name]) for p in param_objs}
+            with autograd.pause(train_mode=False), override(mapping):
+                out = self(*[NDArray(x) for x in xs])
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data for o in out)
+            return out._data
+
+        xs = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
+              for x in example_inputs]
+        exported = jexport.export(jax.jit(fn))(*xs)
+        blob = exported.serialize()
+        fname = "%s.stablehlo" % path
+        with open(fname, "wb") as f:
+            f.write(blob)
+        return fname
 
 
 def _is_traced_nd(x):
@@ -358,50 +438,151 @@ def _is_traced_nd(x):
 
 class SymbolBlock(HybridBlock):
     """Construct a block from a symbol graph (reference: block.py:953).
-    Implemented with the Symbol layer (mxnet_tpu/symbol)."""
+    Implemented with the Symbol layer (mxnet_tpu/symbol): forward binds
+    a graph executor (cached per input signature) with the block's
+    parameters as args/aux."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=None)
-        self._outputs = outputs
-        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        from ..symbol import symbol as _symmod
+        if isinstance(outputs, (list, tuple)):
+            from .. import symbol as _sym
 
-        arg_names = set()
-        for o in (outputs if isinstance(outputs, (list, tuple)) else [outputs]):
-            arg_names.update(o.list_arguments())
+            outputs = _sym.Group(list(outputs))
+        self._outputs = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        self._executors = {}
         input_names = {i.name for i in self._inputs}
         if params is None:
             params = {}
-        for name in arg_names:
-            if name not in input_names:
-                p = params.get(name)
-                if isinstance(p, Parameter):
-                    self._params._params[name] = p
-                else:
-                    newp = self._params.get(name, allow_deferred_init=True)
-                    if p is not None:
-                        newp.shape = p.shape
-                        newp.initialize()
-                        newp.set_data(p)
+        aux_set = set(outputs.list_auxiliary_states())
+        for name in (list(outputs.list_arguments()) + sorted(aux_set)):
+            if name in input_names:
+                continue
+            p = params.get(name)
+            if isinstance(p, Parameter):
+                self._params._params[name] = p
+            else:
+                newp = self._params.get(
+                    name, allow_deferred_init=True,
+                    grad_req="null" if name in aux_set else "write")
+                if p is not None:                    # NDArray / ndarray
+                    newp.shape = tuple(p.shape)
+                    newp.initialize()
+                    newp.set_data(p)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        from ..symbol import symbol as _symmod
+        """Reload an exported model (reference block.py:SymbolBlock.imports
+        :1032). Accepts the ``arg:``/``aux:``-prefixed checkpoint format
+        written by `HybridBlock.export` (and plain-name files)."""
+        from .. import symbol as _sym
 
-        sym = _symmod.load(symbol_file)
+        sym = _sym.load(symbol_file)
         if isinstance(input_names, str):
             input_names = [input_names]
-        inputs = [_symmod.var(n) for n in input_names]
-        block = SymbolBlock(sym, inputs)
+        inputs = [_sym.var(n) for n in input_names]
+        params = {}
         if param_file:
-            block.load_parameters(param_file, ctx=ctx, allow_missing=False,
-                                  ignore_extra=True)
+            loaded = nd.load(param_file)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) \
+                    else k
+                params[name] = v.as_in_context(ctx) if ctx is not None else v
+            # allow_missing=False semantics: a truncated checkpoint must
+            # fail HERE with the missing names, not as a deferred-init
+            # error on first forward.
+            input_names = set(input_names)
+            missing = [n for n in (list(sym.list_arguments())
+                                   + list(sym.list_auxiliary_states()))
+                       if n not in input_names and n not in params]
+            if missing:
+                raise ValueError(
+                    "Parameter file %s is missing graph parameters %s"
+                    % (param_file, sorted(missing)))
+        block = SymbolBlock(sym, inputs, params=params)
+        if ctx is not None:
+            block.collect_params().reset_ctx(ctx)
         return block
 
-    def forward(self, *args):
-        from ..symbol import symbol as _symmod
+    def _forward_imperative(self, data):
+        """Tape-recording DAG walk: every node dispatches through the
+        imperative nd path so autograd records vjps — imported models
+        are trainable (reference SymbolBlock trains like any Block)."""
+        from .. import autograd as _ag
+        from ..ndarray.ndarray import _invoke
+        from ..ops import registry as _reg
 
-        kwargs = {p.name: p.data() for p in self._params.values()}
+        cache = {}
+
+        def value_of(node, out_index):
+            key = (node._uid, out_index or 0)
+            if key in cache:
+                return cache[key]
+            if node._op is None:
+                v = data.get(node._name)
+                if v is None:
+                    v = self._params[node._name].data()
+                cache[key] = v
+                return v
+            op_name = node._attrs.get("_op_name", node._op)
+            in_vals = [value_of(i, i._out_index or 0)
+                       for i in node._inputs]
+            attrs = node._clean_attrs()
+            if _reg.get(op_name).train_aware:
+                # drop any baked-in mode so _invoke injects the CURRENT
+                # autograd train state (Executor._eval_graph does the
+                # same override for train-aware ops)
+                attrs.pop("training", None)
+            res = _invoke(op_name, in_vals, **attrs)
+            outs = res if isinstance(res, (tuple, list)) else (res,)
+            # aux writes (BatchNorm moving stats) route back into the
+            # aux parameters, mirroring Executor._eval_graph.
+            aux_inputs = [i for i in node._inputs
+                          if i._op is None and i._is_aux]
+            if aux_inputs and len(outs) == 1 + len(aux_inputs) and \
+                    _ag.is_training():
+                for a, v in zip(aux_inputs, outs[1:]):
+                    if a._name in self._params:
+                        self._params[a._name].set_data(v)
+                outs = outs[:1]
+            elif aux_inputs and len(outs) == 1 + len(aux_inputs):
+                outs = outs[:1]
+            for i, o in enumerate(outs):
+                cache[(node._uid, i)] = o
+            return cache[(node._uid, out_index or 0)]
+
+        outs = [value_of(s, s._out_index or 0)
+                for s in self._outputs.outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def forward(self, *args):
+        from .. import autograd as _ag
+
+        data = {}
         for inp, val in zip(self._inputs, args):
-            kwargs[inp.name] = val
-        return self._outputs.eval_with(kwargs)
+            data[inp.name] = val if isinstance(val, NDArray) \
+                else nd.array(val)
+        if _ag.is_recording():
+            return self._forward_imperative(data)
+        sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in data.items()))
+        ex = self._executors.get(sig)
+        if ex is None:
+            # Data inputs bind as COPIES (Executor.forward writes
+            # fed values into the bound arrays in place — binding the
+            # caller's NDArray would corrupt it on later calls).
+            # Parameters bind by reference: set_data mutates the same
+            # buffers, so updates between calls are visible with no
+            # per-call re-feed.
+            args_map = {k: v.copy() for k, v in data.items()}
+            for n in self._outputs.list_arguments():
+                if n not in args_map:
+                    args_map[n] = self._params[n].data()
+            aux_map = {n: self._params[n].data()
+                       for n in self._outputs.list_auxiliary_states()}
+            ex = self._outputs.bind(args=args_map, aux_states=aux_map,
+                                    grad_req="null")
+            self._executors[sig] = ex
+        outs = ex.forward(is_train=_ag.is_training(), **data)
+        return outs[0] if len(outs) == 1 else list(outs)

@@ -113,3 +113,120 @@ def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
     ll = m_safe + jnp.log(jnp.exp(last_blank - m_safe) +
                           jnp.exp(last_label - m_safe))
     return -ll
+
+
+# ---------------------------------------------------------------------------
+# small contrib tail: adaptive pooling, resize, fft, index_copy,
+# count_sketch (reference: src/operator/contrib/{adaptive_avg_pooling,
+# bilinear_resize, fft, ifft, index_copy, count_sketch}.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_AdaptiveAvgPooling2D",
+          aliases=("_contrib_adaptive_avg_pooling2d",))
+def adaptive_avg_pooling2d(data, output_size=1):
+    """Adaptive average pooling to a fixed output grid (reference
+    adaptive_avg_pooling.cc:38-65): bin [oh] spans rows
+    floor(oh*H/OH) .. ceil((oh+1)*H/OH). Expressed as a dense
+    averaging matrix per axis — two small matmuls instead of a
+    gather-loop, which XLA maps onto the MXU."""
+    if isinstance(output_size, (tuple, list)):
+        oh, ow = int(output_size[0]), int(output_size[1] if
+                                          len(output_size) > 1
+                                          else output_size[0])
+    else:
+        oh = ow = int(output_size)
+    H, W = data.shape[2], data.shape[3]
+
+    import numpy as _np
+
+    def axis_matrix(size, osize):
+        m = _np.zeros((osize, size), _np.float32)
+        for o in range(osize):
+            a = int(_np.floor(o * size / osize))
+            b = int(_np.ceil((o + 1) * size / osize))
+            m[o, a:b] = 1.0 / (b - a)
+        return jnp.asarray(m)
+
+    mh = axis_matrix(H, oh)                       # (oh, H)
+    mw = axis_matrix(W, ow)                       # (ow, W)
+    out = jnp.einsum("oh,nchw,pw->ncop", mh, data.astype(jnp.float32), mw)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_BilinearResize2D",
+          aliases=("_contrib_bilinear_resize2d",))
+def bilinear_resize2d(data, height=None, width=None, scale_height=None,
+                      scale_width=None):
+    """Bilinear up/downsampling with align_corners semantics
+    (bilinear_resize.cc:67-70: ratio = (in-1)/(out-1)), matching the
+    reference's caffe-derived kernel."""
+    H, W = data.shape[2], data.shape[3]
+    oh = int(height) if height else int(round(H * float(scale_height)))
+    ow = int(width) if width else int(round(W * float(scale_width)))
+    if oh == H and ow == W:
+        return data
+    rh = (H - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    rw = (W - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    hr = jnp.arange(oh, dtype=jnp.float32) * rh
+    wr = jnp.arange(ow, dtype=jnp.float32) * rw
+    h0 = jnp.clip(jnp.floor(hr), 0, H - 1).astype(jnp.int32)
+    w0 = jnp.clip(jnp.floor(wr), 0, W - 1).astype(jnp.int32)
+    h1 = jnp.minimum(h0 + 1, H - 1)
+    w1 = jnp.minimum(w0 + 1, W - 1)
+    lh = (hr - h0)[:, None]                       # (oh, 1)
+    lw = (wr - w0)[None, :]                       # (1, ow)
+    d = data.astype(jnp.float32)
+    tl = d[:, :, h0][:, :, :, w0]
+    tr = d[:, :, h0][:, :, :, w1]
+    bl = d[:, :, h1][:, :, :, w0]
+    br = d[:, :, h1][:, :, :, w1]
+    out = ((1 - lh) * ((1 - lw) * tl + lw * tr)
+           + lh * ((1 - lw) * bl + lw * br))
+    return out.astype(data.dtype)
+
+
+@register("_contrib_fft", aliases=("_contrib_FFT",))
+def contrib_fft(data, compute_size=128):
+    """1D FFT over the last axis; real input (..., d) -> interleaved
+    real/imag output (..., 2d) (fft-inl.h: cufft C2C forward).
+    compute_size (sub-batch chunking) is a device-memory knob with no
+    effect under XLA."""
+    c = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([c.real, c.imag], axis=-1)
+    return out.reshape(*data.shape[:-1], 2 * data.shape[-1]) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft", aliases=("_contrib_IFFT",))
+def contrib_ifft(data, compute_size=128):
+    """Inverse of `_contrib_fft`: interleaved complex (..., 2d) -> real
+    (..., d). Like the reference (cufft inverse, ifft-inl.h:136 leaves
+    normalization commented out), the result is UNNORMALIZED — callers
+    divide by d, matching `out /= dim_` being the user's job."""
+    d = data.shape[-1] // 2
+    pairs = data.astype(jnp.float32).reshape(*data.shape[:-1], d, 2)
+    c = lax.complex(pairs[..., 0], pairs[..., 1])
+    return (jnp.fft.ifft(c, axis=-1).real * d).astype(jnp.float32)
+
+
+@register("_contrib_index_copy", aliases=("_contrib_IndexCopy",))
+def index_copy(old, index, new):
+    """Copy rows of `new` into `old` at `index` positions
+    (index_copy.cc): out = old; out[index[i]] = new[i]."""
+    return old.at[index.astype(jnp.int32)].set(new.astype(old.dtype))
+
+
+@register("_contrib_count_sketch", aliases=("_contrib_CountSketch",))
+def count_sketch(data, h, s, out_dim=1, processing_batch_size=32):
+    """Count-sketch projection (count_sketch.cc): for input row x,
+    out[h[i]] += s[i] * x[i]. h (1, in_dim) hash bucket per input
+    feature, s (1, in_dim) random signs. One scatter-add; the
+    processing_batch_size chunking knob is a no-op under XLA."""
+    out_dim = int(out_dim)
+    n = data.shape[0]
+    hv = h.reshape(-1).astype(jnp.int32)          # (in_dim,)
+    sv = s.reshape(-1).astype(jnp.float32)
+    vals = data.astype(jnp.float32) * sv[None, :]
+    out = jnp.zeros((n, out_dim), jnp.float32)
+    return out.at[:, hv].add(vals)

@@ -701,6 +701,18 @@ def _make_symbol_op(op_name):
         # auto-create missing learnable/aux inputs
         for pname, is_aux, skip_attr in param_inputs:
             if pname in inputs:
+                # Explicitly-passed bare variables sitting in an aux
+                # slot (BatchNorm moving stats) ARE auxiliary states —
+                # aux-ness comes from the op signature, not from the
+                # caller's grad_req (frozen weights stay args). Mark a
+                # COPY of the variable: mutating the caller's Symbol
+                # would reclassify it in every other graph sharing it.
+                v = inputs[pname]
+                if is_aux and isinstance(v, Symbol) and v._op is None \
+                        and not v._is_aux:
+                    cp = Symbol(None, name=v._name, is_aux=True)
+                    cp._attrs.update(v._attrs)
+                    inputs[pname] = cp
                 continue
             if skip_attr and attrs.get(skip_attr):
                 continue

@@ -214,3 +214,82 @@ def test_pallas_kernel_launch():
 def test_cuda_module_raises():
     with pytest.raises(NotImplementedError):
         mx.rtc.CudaModule("__global__ void k() {}")
+
+
+# -- contrib tail: adaptive pool / resize / fft / index_copy / count_sketch --
+
+def test_adaptive_avg_pooling2d_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 7, 5).astype(np.float32)
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(
+        mx.nd.array(x), output_size=(3, 2)).asnumpy()
+    want = np.zeros((2, 3, 3, 2), np.float32)
+    for oh in range(3):
+        a, b = int(np.floor(oh * 7 / 3)), int(np.ceil((oh + 1) * 7 / 3))
+        for ow in range(2):
+            c, d = int(np.floor(ow * 5 / 2)), int(np.ceil((ow + 1) * 5 / 2))
+            want[:, :, oh, ow] = x[:, :, a:b, c:d].mean(axis=(2, 3))
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    # global pooling special case == mean
+    g = mx.nd.contrib.AdaptiveAvgPooling2D(mx.nd.array(x),
+                                           output_size=1).asnumpy()
+    np.testing.assert_allclose(g[:, :, 0, 0], x.mean(axis=(2, 3)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_resize2d_align_corners():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    out = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), height=7,
+                                         width=7).asnumpy()
+    # align_corners: corners map exactly
+    np.testing.assert_allclose(out[..., 0, 0], x[..., 0, 0], rtol=1e-5)
+    np.testing.assert_allclose(out[..., -1, -1], x[..., -1, -1], rtol=1e-5)
+    np.testing.assert_allclose(out[..., 0, -1], x[..., 0, -1], rtol=1e-5)
+    # midpoints on a 4->7 grid interpolate between neighbours
+    want_mid = 0.5 * (x[..., 0, 0] + x[..., 0, 1])
+    np.testing.assert_allclose(out[..., 0, 1], want_mid, rtol=1e-4)
+    # identity when sizes match
+    same = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), height=4,
+                                          width=4).asnumpy()
+    np.testing.assert_allclose(same, x)
+    # scale_* spelling
+    up = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), scale_height=2.0,
+                                        scale_width=2.0).asnumpy()
+    assert up.shape == (1, 2, 8, 8)
+
+
+def test_contrib_fft_ifft_roundtrip():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 8).astype(np.float32)
+    f = mx.nd.contrib.fft(mx.nd.array(x)).asnumpy()
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, rtol=1e-4, atol=1e-4)
+    # reference ifft is unnormalized: divide by d to invert (the
+    # reference's own example does the same)
+    back = mx.nd.contrib.ifft(mx.nd.array(f)).asnumpy() / 8.0
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_index_copy():
+    old = mx.nd.zeros((5, 3))
+    new = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    idx = mx.nd.array(np.array([4, 0], np.float32))
+    out = mx.nd.contrib.index_copy(old, idx, new).asnumpy()
+    want = np.zeros((5, 3), np.float32)
+    want[4] = [0, 1, 2]
+    want[0] = [3, 4, 5]
+    np.testing.assert_allclose(out, want)
+
+
+def test_contrib_count_sketch():
+    x = np.array([[1., 2., 3., 4.]], np.float32)
+    h = np.array([[0, 1, 1, 2]], np.float32)
+    s = np.array([[1, -1, 1, 1]], np.float32)
+    out = mx.nd.contrib.count_sketch(
+        mx.nd.array(x), mx.nd.array(h), mx.nd.array(s),
+        out_dim=3).asnumpy()
+    # bucket0: +1*1 ; bucket1: -1*2 + 1*3 ; bucket2: +1*4
+    np.testing.assert_allclose(out, [[1., 1., 4.]])

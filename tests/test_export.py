@@ -1,0 +1,276 @@
+"""Graph export round-trip (reference: gluon/block.py:export :1008 +
+SymbolBlock.imports :1032, tests/python/unittest/test_gluon.py export
+tests) and StableHLO deployment artifacts (TPU-native analogue of the
+reference's C predict API deployment path)."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(8, activation="relu"),
+            gluon.nn.Dense(4))
+    return net
+
+
+def _convnet():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(5))
+    return net
+
+
+def test_export_imports_roundtrip_mlp(tmp_path):
+    net = _mlp()
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(3, 12)
+                    .astype(np.float32))
+    want = net(x).asnumpy()
+
+    prefix = str(tmp_path / "mlp")
+    sym_file, params_file = net.export(prefix, epoch=3)
+    assert sym_file.endswith("mlp-symbol.json")
+    assert params_file.endswith("mlp-0003.params")
+    assert os.path.exists(sym_file) and os.path.exists(params_file)
+
+    # the json is a real symbol graph, not a blob
+    graph = json.loads(open(sym_file).read())
+    assert "nodes" in graph and any(
+        n.get("op", "null") != "null" for n in graph["nodes"])
+
+    reloaded = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    got = reloaded(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_export_imports_roundtrip_convnet_with_aux(tmp_path):
+    """BatchNorm running stats ride the aux: section and must restore."""
+    net = _convnet()
+    net.initialize()
+    rng = np.random.RandomState(1)
+    # a few training steps so running stats are non-trivial
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    for _ in range(3):
+        xb = mx.nd.array(rng.rand(4, 2, 8, 8).astype(np.float32))
+        with autograd.record():
+            loss = (net(xb) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+
+    x = mx.nd.array(rng.rand(2, 2, 8, 8).astype(np.float32))
+    with autograd.pause(train_mode=False):
+        want = net(x).asnumpy()
+
+    prefix = str(tmp_path / "cnn")
+    sym_file, params_file = net.export(prefix)
+    saved = mx.nd.load(params_file)
+    assert any(k.startswith("aux:") for k in saved), \
+        "BatchNorm running stats missing from aux: section"
+    assert any(k.startswith("arg:") for k in saved)
+
+    reloaded = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    with autograd.pause(train_mode=False):
+        got = reloaded(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_symbolblock_reload_sees_param_updates(tmp_path):
+    net = _mlp()
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(2).rand(2, 12)
+                    .astype(np.float32))
+    net(x)
+    prefix = str(tmp_path / "m")
+    sym_file, params_file = net.export(prefix)
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    out1 = blk(x).asnumpy()
+    # mutate a parameter; the cached executor must see the new value
+    name, p = next(iter(blk.collect_params().items()))
+    p.set_data(p.data() * 0.0)
+    out2 = blk(x).asnumpy()
+    assert not np.allclose(out1, out2)
+
+
+def test_export_stablehlo_standalone(tmp_path):
+    """The .stablehlo artifact runs through plain jax.export with no
+    mxnet_tpu involvement — weights embedded."""
+    net = _mlp()
+    net.initialize()
+    x = np.random.RandomState(3).rand(2, 12).astype(np.float32)
+    want = net(mx.nd.array(x)).asnumpy()
+
+    fname = net.export_stablehlo(str(tmp_path / "mlp"), x)
+    assert fname.endswith(".stablehlo") and os.path.exists(fname)
+
+    # deployment side: plain jax only
+    import jax
+    from jax import export as jexport
+
+    blob = open(fname, "rb").read()
+    loaded = jexport.deserialize(blob)
+    got = np.asarray(loaded.call(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_export_multi_input(tmp_path):
+    class TwoIn(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = gluon.nn.Dense(4)
+            self.register_child(self.d)
+
+        def hybrid_forward(self, F, a, b):
+            return self.d(a) + self.d(b)
+
+    net = TwoIn()
+    net.initialize()
+    a = mx.nd.array(np.random.RandomState(4).rand(2, 6).astype(np.float32))
+    b = mx.nd.array(np.random.RandomState(5).rand(2, 6).astype(np.float32))
+    want = net(a, b).asnumpy()
+    prefix = str(tmp_path / "two")
+    sym_file, params_file = net.export(prefix)
+    blk = gluon.SymbolBlock.imports(sym_file, ["data0", "data1"],
+                                    params_file)
+    got = blk(a, b).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_export_frozen_params_stay_args(tmp_path):
+    """grad_req='null' freezing must NOT reclassify weights as aux —
+    only true auxiliary states (BatchNorm moving stats) ride aux:."""
+    net = _convnet()
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(6).rand(1, 2, 8, 8)
+                    .astype(np.float32))
+    net(x)
+    for p in net.collect_params().values():
+        p._grad_req = "null"                # freeze everything
+    sym_file, params_file = net.export(str(tmp_path / "fz"))
+    saved = mx.nd.load(params_file)
+    aux = {k for k in saved if k.startswith("aux:")}
+    arg = {k for k in saved if k.startswith("arg:")}
+    assert all("running_" in k for k in aux), aux
+    assert any("weight" in k for k in arg)
+    assert not any("weight" in k for k in aux)
+
+
+def test_symbolblock_is_trainable(tmp_path):
+    """Imported models fine-tune: gradients flow and loss drops
+    (reference SymbolBlock trains like any Block)."""
+    net = _mlp()
+    net.initialize()
+    rng = np.random.RandomState(7)
+    X = rng.rand(32, 12).astype(np.float32)
+    y = (X.sum(axis=1) > 6).astype(np.float32)
+    xnd = mx.nd.array(X)
+    net(xnd)
+    sym_file, params_file = net.export(str(tmp_path / "t"))
+
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    # output dim 4 -> binary via first two logits
+    trainer = gluon.Trainer(blk.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    ynd = mx.nd.array(y)
+    first = last = None
+    for _ in range(25):
+        with autograd.record():
+            out = blk(xnd)
+            loss = ce(out.slice_axis(axis=1, begin=0, end=2), ynd).mean()
+        loss.backward()
+        trainer.step(32)
+        last = float(loss.asnumpy().ravel()[0])
+        if first is None:
+            first = last
+    assert last < first * 0.7, "SymbolBlock loss %.4f -> %.4f" % (first, last)
+    # gradients actually reached the imported parameters
+    gsum = sum(float(mx.nd.abs(p.grad()).sum().asnumpy())
+               for p in blk.collect_params().values()
+               if p.grad_req != "null")
+    assert gsum > 0
+
+
+def test_symbolblock_trains_batchnorm_aux(tmp_path):
+    """Fine-tuning through an imported BatchNorm updates moving stats."""
+    net = _convnet()
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(8).rand(4, 2, 8, 8)
+                    .astype(np.float32))
+    net(x)
+    sym_file, params_file = net.export(str(tmp_path / "bn"))
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    aux_before = {n: p.data().asnumpy().copy()
+                  for n, p in blk.collect_params().items()
+                  if "running" in n}
+    assert aux_before
+    trainer = gluon.Trainer(blk.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    with autograd.record():
+        loss = (blk(x) ** 2).mean()
+    loss.backward()
+    trainer.step(4)
+    changed = any(
+        not np.allclose(aux_before[n], p.data().asnumpy())
+        for n, p in blk.collect_params().items() if n in aux_before)
+    assert changed, "BatchNorm moving stats never updated during training"
+
+
+def test_symbolblock_does_not_corrupt_caller_inputs(tmp_path):
+    """The cached executor must not bind the caller's array: feeding a
+    second input must leave the first untouched."""
+    net = _mlp()
+    net.initialize()
+    x0 = mx.nd.array(np.random.RandomState(9).rand(1, 12)
+                     .astype(np.float32))
+    net(x0)
+    sym_file, params_file = net.export(str(tmp_path / "c"))
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    x1 = mx.nd.ones((1, 12))
+    x2 = mx.nd.ones((1, 12)) * 5
+    keep = x1.asnumpy().copy()
+    blk(x1)
+    blk(x2)
+    np.testing.assert_allclose(x1.asnumpy(), keep)
+
+
+def test_imports_missing_params_fail_fast(tmp_path):
+    net = _mlp()
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(10).rand(1, 12)
+                    .astype(np.float32))
+    net(x)
+    sym_file, params_file = net.export(str(tmp_path / "mf"))
+    trunc = {k: v for i, (k, v) in
+             enumerate(mx.nd.load(params_file).items()) if i != 0}
+    mx.nd.save(params_file, trunc)
+    import pytest
+
+    with pytest.raises(ValueError, match="missing graph parameters"):
+        gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+
+
+def test_shared_var_not_reclassified_by_aux_slot():
+    """Passing a var into a BatchNorm aux slot must not flip it to aux
+    in OTHER graphs sharing the same var."""
+    rm = mx.sym.var("rm")
+    g1 = rm * 2.0
+    assert "rm" in g1.list_arguments()
+    x = mx.sym.var("x")
+    gamma = mx.sym.var("g")
+    beta = mx.sym.var("b")
+    rv = mx.sym.var("rv")
+    bn = mx.sym.BatchNorm(x, gamma, beta, rm, rv)
+    assert "rm" in bn.list_auxiliary_states()
+    # original graph unchanged
+    assert "rm" in g1.list_arguments()

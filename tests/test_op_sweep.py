@@ -308,6 +308,21 @@ spec("ctc_loss", lambda: [_sym(5, 2, 4),
                           np.array([[1, 2], [2, 3]], np.float32)],
      mode="fwd")
 
+# -- contrib tail (adaptive pool, resize, fft, index_copy, count_sketch) -----
+spec("_contrib_AdaptiveAvgPooling2D", lambda: [_sym(2, 3, 7, 5)],
+     {"output_size": (3, 2)})
+spec("_contrib_BilinearResize2D", lambda: [_sym(2, 3, 5, 4)],
+     {"height": 9, "width": 7})
+spec("_contrib_fft", lambda: [_sym(3, 8)], mode="fwd")
+spec("_contrib_ifft", lambda: [_sym(3, 16)], mode="fwd")
+spec("_contrib_index_copy",
+     lambda: [_sym(5, 3), np.array([0, 3], np.float32), _sym(2, 3)],
+     mode="fwd")
+spec("_contrib_count_sketch",
+     lambda: [_sym(3, 6), np.array([[0, 2, 1, 3, 2, 0]], np.float32),
+              np.array([[1, -1, 1, 1, -1, 1]], np.float32)],
+     {"out_dim": 4}, "fwd")
+
 
 @pytest.mark.parametrize("name", sorted(SPECS))
 def test_op(name):

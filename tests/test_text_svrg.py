@@ -1,0 +1,176 @@
+"""contrib.text (vocabulary/embeddings, reference
+python/mxnet/contrib/text/) and contrib.svrg_optimization (SVRGModule,
+reference python/mxnet/contrib/svrg_optimization/)."""
+import collections
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+# -- text.vocab ---------------------------------------------------------------
+
+def test_vocabulary_ordering_and_thresholds():
+    counter = collections.Counter(
+        ["b", "b", "b", "a", "a", "c", "c", "c", "c", "rare"])
+    v = text.Vocabulary(counter, min_freq=2, unknown_token="<unk>",
+                        reserved_tokens=["<pad>"])
+    # unk=0, reserved next, then freq desc with alpha tie-break
+    assert v.idx_to_token == ["<unk>", "<pad>", "c", "b", "a"]
+    assert v.to_indices("c") == 2
+    assert v.to_indices(["a", "zzz"]) == [4, 0]
+    assert v.to_tokens([2, 3]) == ["c", "b"]
+    assert len(v) == 5
+    # most_freq_count cap
+    v2 = text.Vocabulary(counter, most_freq_count=1)
+    assert v2.idx_to_token == ["<unk>", "c"]
+
+
+def test_vocabulary_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        text.Vocabulary(min_freq=0)
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["<unk>"])
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["a", "a"])
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str("Life is Life\nis good",
+                                         to_lower=True)
+    assert c == collections.Counter(
+        {"life": 2, "is": 2, "good": 1})
+
+
+# -- text.embedding -----------------------------------------------------------
+
+def _write_embedding_file(path):
+    with open(path, "w") as f:
+        f.write("hello 0.1 0.2 0.3\n")
+        f.write("world 1.0 2.0 3.0\n")
+        f.write("tpu 7.0 8.0 9.0\n")
+    return str(path)
+
+
+def test_custom_embedding_loads_and_queries(tmp_path):
+    fname = _write_embedding_file(tmp_path / "emb.txt")
+    emb = text.embedding.CustomEmbedding(fname)
+    assert emb.vec_len == 3
+    assert len(emb) == 4                       # <unk> + 3 tokens
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [1.0, 2.0, 3.0])
+    # unknown -> zeros (init_unknown_vec default)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("missing").asnumpy(), [0, 0, 0])
+    two = emb.get_vecs_by_tokens(["hello", "tpu"]).asnumpy()
+    np.testing.assert_allclose(two, [[0.1, 0.2, 0.3], [7, 8, 9]],
+                               rtol=1e-6)
+    assert emb.idx_to_vec.shape == (4, 3)
+
+
+def test_embedding_update_and_registry(tmp_path):
+    fname = _write_embedding_file(tmp_path / "emb.txt")
+    emb = text.embedding.create("customembedding",
+                                pretrained_file_path=fname)
+    emb.update_token_vectors("hello", mx.nd.array([[9., 9., 9.]]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+    import pytest
+
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("nope", mx.nd.array([[1., 2., 3.]]))
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+
+
+def test_composite_embedding_with_vocabulary(tmp_path):
+    f1 = _write_embedding_file(tmp_path / "e1.txt")
+    with open(tmp_path / "e2.txt", "w") as f:
+        f.write("hello 5 5\nmars 6 6\n")
+    e1 = text.embedding.CustomEmbedding(f1)
+    e2 = text.embedding.CustomEmbedding(str(tmp_path / "e2.txt"))
+    vocab = text.Vocabulary(collections.Counter(
+        ["hello", "hello", "mars"]))
+    comp = text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 5
+    got = comp.get_vecs_by_tokens("hello").asnumpy()
+    np.testing.assert_allclose(got, [0.1, 0.2, 0.3, 5, 5], rtol=1e-6)
+    # token present in vocab but only in one source: other half zeros
+    got = comp.get_vecs_by_tokens("mars").asnumpy()
+    np.testing.assert_allclose(got, [0, 0, 0, 6, 6])
+
+
+# -- svrg ---------------------------------------------------------------------
+
+def _linreg_symbol():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    return mx.sym.LinearRegressionOutput(out, name="lin_reg")
+
+
+def test_svrg_module_converges_and_reduces_variance():
+    rng = np.random.RandomState(0)
+    n = 64
+    X = rng.rand(n, 4).astype(np.float32)
+    w_true = np.array([1.5, -2.0, 0.5, 3.0], np.float32)
+    y = X @ w_true + 0.01 * rng.randn(n).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y.reshape(-1, 1), batch_size=16,
+                           shuffle=True, label_name="lin_reg_label")
+
+    mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                     label_names=("lin_reg_label",), update_freq=2)
+    mod.fit(it, num_epoch=30, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="mse")
+    arg, _ = mod.get_params()
+    w = arg["fc_weight"].asnumpy().ravel()
+    np.testing.assert_allclose(w, w_true, atol=0.25)
+
+
+def test_svrg_full_grads_match_batch_mean():
+    """The stored full gradient equals the mean of per-batch gradients
+    computed at the snapshot weights."""
+    rng = np.random.RandomState(1)
+    X = rng.rand(32, 3).astype(np.float32)
+    y = X.sum(axis=1, keepdims=True).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8,
+                           label_name="lin_reg_label")
+    mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                     label_names=("lin_reg_label",), update_freq=1)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    mod.update_full_grads(it)
+    full = mod._param_dict["fc_weight"].asnumpy()
+
+    # manual mean of batch grads through the plain Module path
+    it.reset()
+    acc, nb = 0, 0
+    for batch in it:
+        mod._mod_aux.forward(batch, is_train=True)
+        mod._mod_aux.backward()
+        acc = acc + mod._mod_aux._execs[0].grad_dict["fc_weight"].asnumpy()
+        nb += 1
+    np.testing.assert_allclose(full, acc / nb, rtol=1e-5, atol=1e-6)
+
+
+def test_svrg_optimizer_routing():
+    from mxnet_tpu.contrib.svrg_optimization import _SVRGOptimizer
+
+    opt = _SVRGOptimizer("sgd", learning_rate=0.5,
+                         param_idx2name={0: "w", 1: "w_full"})
+    w = mx.nd.array([1.0])
+    g = mx.nd.array([0.5])
+    st = opt.create_state(0, w)
+    opt.update(0, w, g, st)
+    # sgd with rescale 1: w -= lr * g  (no wd)
+    np.testing.assert_allclose(w.asnumpy(), [0.75])
+    wf = mx.nd.array([1.0])
+    gf = mx.nd.array([0.125])
+    opt.update(1, wf, gf, opt.create_state(1, wf))
+    np.testing.assert_allclose(wf.asnumpy(), [0.125])  # assignment
