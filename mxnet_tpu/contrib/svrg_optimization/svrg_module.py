@@ -124,14 +124,21 @@ class SVRGModule(Module):
 
     def _update_svrg_gradients(self):
         """grads = g(w, b) - g(w~, b) + full(w~)
-        (reference svrg_module.py:360-393)."""
+        (reference svrg_module.py:360-393).
+
+        Applied per executor with THAT executor's own aux grad and a
+        1/n_exec share of the full gradient: Module.update then sums
+        executor grads, recovering exactly sum(g) - g_aux + g_full —
+        using the cross-executor totals per executor would over-count
+        the correction n_exec times."""
+        n = len(self._execs)
         for name in self._param_names:
-            g_aux = self._grad_of(self._mod_aux, name)
             g_full = self._param_dict[name]
-            for ex in self._execs:
+            for ex, ex_aux in zip(self._execs, self._mod_aux._execs):
                 g = ex.grad_dict[name]
+                g_aux = ex_aux.grad_dict[name]
                 g[:] = g - g_aux.as_in_context(g.context) \
-                    + g_full.as_in_context(g.context)
+                    + (g_full / n).as_in_context(g.context)
 
     # -- training loop -------------------------------------------------------
 

@@ -111,19 +111,20 @@ class TokenEmbedding(_vocab.Vocabulary):
 
     def _build_from_vocabulary(self, vocabulary, *sources):
         """Re-index rows to a user vocabulary
-        (reference embedding.py:305-357)."""
+        (reference embedding.py:305-357). One fancy-index gather per
+        source — not a per-token Python loop, which would take minutes
+        on a real (100k+ token) vocabulary."""
         self._idx_to_token = list(vocabulary.idx_to_token)
         self._token_to_idx = dict(vocabulary.token_to_idx)
         self._unknown_token = vocabulary.unknown_token
         self._reserved_tokens = vocabulary.reserved_tokens
         self._vec_len = sum(s.vec_len for s in sources)
         mat = np.zeros((len(self), self._vec_len), dtype=np.float32)
-        for i, token in enumerate(self._idx_to_token):
-            col = 0
-            for s in sources:
-                mat[i, col:col + s.vec_len] = \
-                    s.get_vecs_by_tokens(token).asnumpy()
-                col += s.vec_len
+        col = 0
+        for s in sources:
+            idx = [s._tok.get(t, 0) for t in self._idx_to_token]
+            mat[:, col:col + s.vec_len] = s._emb_mat[idx]
+            col += s.vec_len
         self._idx_to_vec = mat
 
     # -- queries -------------------------------------------------------------
@@ -195,18 +196,14 @@ class CustomEmbedding(TokenEmbedding):
 
 
 class _Frozen:
-    """Lightweight read-only view used during vocabulary re-indexing."""
+    """Read-only (matrix, token-index) view used during vocabulary
+    re-indexing — decoupled from the source embedding so CustomEmbedding
+    can re-index over ITSELF."""
 
     def __init__(self, emb):
         self.vec_len = emb.vec_len
         self._emb_mat = emb._idx_to_vec.copy()
         self._tok = dict(emb._token_to_idx)
-
-    def get_vecs_by_tokens(self, token):
-        import types
-
-        row = self._emb_mat[self._tok.get(token, 0)]
-        return types.SimpleNamespace(asnumpy=lambda: row)
 
 
 @register

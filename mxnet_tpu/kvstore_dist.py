@@ -80,6 +80,7 @@ class KVStoreDist(KVStoreLocal):
         book = self._sched.recv()
         assert book[0] == "addressbook"
         self._servers = [_client(addr) for addr in book[1]]
+        self._pending_acks = [0] * len(self._servers)
         for conn in self._servers:
             conn.send(("hello", self._sync))
         atexit.register(self.close)
@@ -133,10 +134,86 @@ class KVStoreDist(KVStoreLocal):
 
     # -- transport helpers ----------------------------------------------------
 
+    # Push/init acks are pipelined: the server answers them inline and
+    # in order, so the worker posts sends without waiting and collects
+    # outstanding acks lazily — pushes overlap with compute and with
+    # each other across servers (reference overlaps via engine-var async
+    # ZPush, kvstore_dist.h:350-371). Value-bearing RPCs (pulls) stay
+    # at most one outstanding per connection: sync-mode pulls can be
+    # PARKED server-side mid-round and answered out of order, so they
+    # must never share the wire with another outstanding value request.
+
+    def _reconnect(self, server_idx):
+        """Re-resolve a (possibly restarted) server's address via the
+        scheduler and reconnect (reference: recovered nodes re-announce
+        through the scheduler; peers reconnect on send failure). Any
+        un-collected acks on the dead connection are unknowable — the
+        caller retries its own operation; best-effort semantics match
+        the reference's recovery story."""
+        import time as _t
+
+        deadline = _t.time() + float(os.environ.get(
+            "MXNET_PS_RECONNECT_TIMEOUT", "120"))
+        while True:
+            # Re-query every attempt: the replacement server publishes a
+            # NEW address only once it registers, which may lag the old
+            # one's death.
+            with self._sched_lock:
+                self._sched.send(("servers",))
+                reply = self._sched.recv()
+            assert reply[0] == "servers"
+            try:
+                conn = _client(tuple(reply[1][server_idx]), retry_for=3.0)
+                break
+            except (ConnectionRefusedError, OSError):
+                if _t.time() >= deadline:
+                    raise
+        self._servers[server_idx] = conn
+        self._pending_acks[server_idx] = 0
+        conn.send(("hello", self._sync))
+
+    def _post(self, server_idx, msg):
+        """Fire-and-collect-later send; reply must be a plain ack."""
+        try:
+            self._servers[server_idx].send(msg)
+        except (OSError, EOFError, BrokenPipeError):
+            self._reconnect(server_idx)
+            self._servers[server_idx].send(msg)
+        self._pending_acks[server_idx] += 1
+
+    def _drain_acks(self, server_idx=None):
+        """Collect outstanding acks (surfacing any deferred errors)."""
+        idxs = [server_idx] if server_idx is not None \
+            else range(len(self._servers))
+        for i in idxs:
+            conn = self._servers[i]
+            while self._pending_acks[i]:
+                try:
+                    reply = conn.recv()
+                except (OSError, EOFError):
+                    # Server died with acks in flight; reconnect and move
+                    # on — the retried ops re-post on the new connection.
+                    self._reconnect(i)
+                    break
+                self._pending_acks[i] -= 1
+                if reply[0] == "error":
+                    raise RuntimeError("kvstore server %d: %s"
+                                       % (i, reply[1]))
+
     def _call(self, server_idx, msg):
-        conn = self._servers[server_idx]
-        conn.send(msg)
-        reply = conn.recv()
+        """Blocking RPC for value-bearing requests; retries once through
+        a reconnect if the server went away mid-exchange."""
+        self._drain_acks(server_idx)
+        for attempt in (0, 1):
+            conn = self._servers[server_idx]
+            try:
+                conn.send(msg)
+                reply = conn.recv()
+                break
+            except (OSError, EOFError, BrokenPipeError):
+                if attempt:
+                    raise
+                self._reconnect(server_idx)
         if reply[0] == "error":
             raise RuntimeError("kvstore server %d: %s" % (server_idx, reply[1]))
         return reply[1] if len(reply) > 1 else None
@@ -206,9 +283,10 @@ class KVStoreDist(KVStoreLocal):
                 part = arr if sl is None else flat[sl]
                 if self._compression is not None:
                     packed, meta = self._compression.compress(subkey, part)
-                    self._call(sidx, ("push_compressed", subkey, packed, meta))
+                    self._post(sidx, ("push_compressed", subkey, packed,
+                                      meta))
                 else:
-                    self._call(sidx, ("push", subkey, part))
+                    self._post(sidx, ("push", subkey, part))
 
     def _push_row_sparse(self, k, vlist):
         """Merge row_sparse device grads by concatenating (indices, values)
@@ -218,7 +296,7 @@ class KVStoreDist(KVStoreLocal):
                               for v in vlist])
         val = np.concatenate([v.data.asnumpy() for v in vlist])
         sidx, subkey, _ = self._shards(k, self._meta[k][0], "row_sparse")[0]
-        self._call(sidx, ("push_rsp", subkey, idx, val))
+        self._post(sidx, ("push_rsp", subkey, idx, val))
 
     def _fetch(self, k):
         shape, dtype, stype = self._meta[k]
@@ -226,8 +304,44 @@ class KVStoreDist(KVStoreLocal):
         if len(shards) == 1 and shards[0][2] is None:
             return np.asarray(self._call(shards[0][0],
                                          ("pull", shards[0][1]))).reshape(shape)
-        out = np.empty(int(np.prod(shape)), dtype=dtype)
+        # Big-array shards live one-per-server (contiguous slicing across
+        # all servers): issue every shard pull first, then collect — the
+        # servers serve and transfer concurrently instead of one
+        # round-trip at a time.
+        assert len({s[0] for s in shards}) == len(shards), \
+            "sharding invariant broken: multiple shards on one server"
+        issued = []
         for sidx, subkey, sl in shards:
+            self._drain_acks(sidx)
+            try:
+                self._servers[sidx].send(("pull", subkey))
+                issued.append((sidx, subkey, sl, True))
+            except (OSError, EOFError, BrokenPipeError):
+                issued.append((sidx, subkey, sl, False))
+        out = np.empty(int(np.prod(shape)), dtype=dtype)
+        retry = []
+        errors = []
+        # Consume EVERY in-flight reply before surfacing any error: an
+        # early raise would leave the other connections' pull replies
+        # unconsumed and permanently desync their request/reply framing.
+        for sidx, subkey, sl, sent in issued:
+            if sent:
+                try:
+                    reply = self._servers[sidx].recv()
+                except (OSError, EOFError):
+                    retry.append((sidx, subkey, sl))
+                    continue
+                if reply[0] == "error":
+                    errors.append((sidx, reply[1]))
+                else:
+                    out[sl] = reply[1]
+            else:
+                retry.append((sidx, subkey, sl))
+        if errors:
+            raise RuntimeError("; ".join(
+                "kvstore server %d: %s" % (s, e) for s, e in errors))
+        for sidx, subkey, sl in retry:
+            # dead server: _call reconnects via the scheduler and retries
             out[sl] = self._call(sidx, ("pull", subkey))
         return out.reshape(shape)
 
@@ -320,6 +434,9 @@ class KVStoreDist(KVStoreLocal):
         MXKVStoreBarrier over the ps-lite scheduler). Holds the scheduler
         channel for the duration — heartbeats pause, which is fine: the
         scheduler counts the barrier message itself as liveness."""
+        # In-flight pushes must be PROCESSED before we report arrival:
+        # a peer may pull right after the barrier.
+        self._drain_acks()
         with self._sched_lock:
             self._sched.send(("barrier",))
             reply = self._sched.recv()
@@ -334,6 +451,11 @@ class KVStoreDist(KVStoreLocal):
         if self._closed:
             return
         self._closed = True
+        try:
+            # surface any deferred push errors before tearing down
+            self._drain_acks()
+        except (OSError, EOFError, RuntimeError):
+            pass
         try:
             with self._sched_lock:
                 self._sched.send(("finalize",))

@@ -150,8 +150,16 @@ class Scheduler:
         recover = msg[3] if len(msg) > 3 else None
         with self._lock:
             if role == "server":
-                node_id = self._next_server
-                self._next_server += 1
+                if recover is not None:
+                    # Restarted server rejoining under its old rank: its
+                    # new address replaces the dead one; workers refresh
+                    # via the "servers" command when their connection
+                    # drops (reference ps::Postoffice::is_recovery,
+                    # kvstore_dist.h:52-55 — server side).
+                    node_id = int(recover)
+                else:
+                    node_id = self._next_server
+                    self._next_server += 1
                 self._servers[node_id] = msg[2]
             elif recover is not None:
                 # Restarted worker rejoining under its old rank: clear
@@ -201,6 +209,13 @@ class Scheduler:
                     self._dead.add(node_id)
             if msg[0] == "heartbeat":
                 continue
+            if msg[0] == "servers":
+                # Current server addressbook — lets a worker re-resolve
+                # a restarted server's new address.
+                with self._lock:
+                    book = [self._servers[i] for i in sorted(self._servers)]
+                conn.send(("servers", book))
+                continue
             if msg[0] == "dead_nodes":
                 timeout = float(msg[1])
                 now = time.time()
@@ -243,13 +258,14 @@ class Scheduler:
 # ---------------------------------------------------------------------------
 
 class _KeyState:
-    __slots__ = ("stored", "accum", "count", "pending_pulls")
+    __slots__ = ("stored", "accum", "count", "pending_pulls", "pushed_by")
 
     def __init__(self, value):
         self.stored = value                     # np.ndarray
         self.accum = None
         self.count = 0
         self.pending_pulls = []                 # [(conn, rows or None)]
+        self.pushed_by = set()                  # conns in the open round
 
 
 class KVStoreServer:
@@ -274,9 +290,86 @@ class KVStoreServer:
         self.host = host or os.environ.get("DMLC_NODE_HOST", "127.0.0.1")
         self._keys = {}
         self._updater = None
+        self._opt_blob = None       # pickled optimizer for snapshots
         self._sync_mode = True
         self._queue = queue.Queue()
         self.server_id = None
+        # Snapshot-backed recovery (reference is_recovery for servers,
+        # kvstore_dist.h:52-55): with MXNET_PS_SNAPSHOT_DIR set, shard
+        # state is persisted after every applied update, and a process
+        # restarted with DMLC_SERVER_RECOVERY=<rank> restores it and
+        # rejoins under its old rank. Without the dir, recovery still
+        # rejoins but starts empty (workers must re-init).
+        self._snapshot_dir = os.environ.get("MXNET_PS_SNAPSHOT_DIR")
+        self._snap_every = max(1, int(os.environ.get(
+            "MXNET_PS_SNAPSHOT_EVERY", "1")))
+        self._snap_counter = 0
+
+    # -- snapshot/recovery ----------------------------------------------------
+    # Per-key value files keep each applied update O(that key's size);
+    # the meta file (optimizer blob + updater states, O(model)) is
+    # throttled by MXNET_PS_SNAPSHOT_EVERY applies — at scale, restored
+    # optimizer state may be a few steps stale (best-effort, like the
+    # reference's recovery story), while stored values are exact.
+
+    def _base_path(self):
+        return os.path.join(self._snapshot_dir,
+                            "server_%d" % self.server_id)
+
+    def _key_path(self, key):
+        import hashlib
+
+        h = hashlib.md5(repr(key).encode()).hexdigest()[:16]
+        return "%s.key_%s.pkl" % (self._base_path(), h)
+
+    @staticmethod
+    def _atomic_write(path, blob):
+        with open(path + ".tmp", "wb") as f:
+            f.write(blob)
+        os.replace(path + ".tmp", path)
+
+    def _write_snapshot(self, key=None):
+        """Persist one key's stored value (key given) and, on schedule
+        or when key is None, the optimizer meta."""
+        if self._snapshot_dir is None or self.server_id is None:
+            return
+        if key is not None:
+            self._atomic_write(self._key_path(key), pickle.dumps(
+                {"key": key, "stored": self._keys[key].stored}))
+            self._snap_counter += 1
+            if self._snap_counter % self._snap_every:
+                return
+        states = (self._updater.get_states(dump_optimizer=False)
+                  if self._updater is not None else None)
+        self._atomic_write(self._base_path() + ".meta.pkl", pickle.dumps(
+            {"opt_blob": self._opt_blob, "updater_states": states}))
+
+    def _load_snapshot(self):
+        import glob
+
+        if self._snapshot_dir is None:
+            return False
+        found = False
+        for path in glob.glob(self._base_path() + ".key_*.pkl"):
+            with open(path, "rb") as f:
+                rec = pickle.load(f)
+            self._keys[rec["key"]] = _KeyState(rec["stored"])
+            found = True
+        meta_path = self._base_path() + ".meta.pkl"
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                meta = pickle.load(f)
+            self._opt_blob = meta["opt_blob"]
+            if self._opt_blob is not None:
+                from . import optimizer as opt
+
+                self._updater = opt.get_updater(
+                    pickle.loads(self._opt_blob))
+                if meta["updater_states"]:
+                    self._updater.set_states(meta["updater_states"])
+            found = True
+        _dbg("recovered %d keys from snapshot" % len(self._keys))
+        return found
 
     # -- update application (executor thread only) ----------------------------
 
@@ -331,6 +424,7 @@ class KVStoreServer:
             self._sync_mode = bool(msg[1])
         elif cmd == "init":
             self._keys[msg[1]] = _KeyState(np.asarray(msg[2]))
+            self._write_snapshot(msg[1])
             self._send(conn, ("ok",))
         elif cmd in ("push", "push_compressed", "push_rsp"):
             key = msg[1]
@@ -341,16 +435,20 @@ class KVStoreServer:
             grad = self._grad_from_msg(msg, state)
             if not self._sync_mode:
                 self._apply(key, state, grad)
+                self._write_snapshot(key)
                 self._send(conn, ("ok",))
                 return
             if state.accum is None:
                 state.accum = np.zeros(state.stored.shape, dtype=np.float32)
             state.accum += grad
             state.count += 1
+            state.pushed_by.add(id(conn))
             if state.count == self.num_workers:
                 self._apply(key, state, state.accum)
                 state.accum = None
                 state.count = 0
+                state.pushed_by.clear()
+                self._write_snapshot(key)
                 for (pconn, prows) in state.pending_pulls:
                     self._answer_pull(pconn, state, prows)
                 state.pending_pulls = []
@@ -362,15 +460,25 @@ class KVStoreServer:
                 self._send(conn, ("error", "key %r not initialized" % (key,)))
                 return
             rows = np.asarray(msg[2]) if cmd == "pull_rows" else None
-            if self._sync_mode and state.count != 0:
-                # Mid sync-round: park until ApplyUpdates flushes us.
+            if self._sync_mode and state.count != 0 and \
+                    id(conn) in state.pushed_by:
+                # This worker contributed to the OPEN round, so it
+                # expects the value that includes its push: park until
+                # ApplyUpdates flushes it. A puller that has NOT pushed
+                # into the open round wants the last COMPLETED round —
+                # answer immediately (parking it would deadlock lockstep
+                # workers once pushes are pipelined: a fast worker's
+                # next-step push opens a round the slow worker can never
+                # help close while its own pull is parked).
                 state.pending_pulls.append((conn, rows))
             else:
                 self._answer_pull(conn, state, rows)
         elif cmd == "set_optimizer":
             from . import optimizer as opt
 
+            self._opt_blob = msg[1]
             self._updater = opt.get_updater(pickle.loads(msg[1]))
+            self._write_snapshot()
             self._send(conn, ("ok",))
         elif cmd == "get_states":
             blob = (self._updater.get_states(dump_optimizer=False)
@@ -399,10 +507,14 @@ class KVStoreServer:
         listener = _listener(self.host, 0)
         addr = listener.address
         sched = _client(self.scheduler_addr)
-        sched.send(("register", "server", (addr[0], addr[1])))
+        recover = os.environ.get("DMLC_SERVER_RECOVERY")
+        sched.send(("register", "server", (addr[0], addr[1]),
+                    int(recover) if recover else None))
         reply = sched.recv()
         assert reply[0] == "registered"
         self.server_id = reply[1]
+        if recover is not None:
+            self._load_snapshot()
         book = sched.recv()
         assert book[0] == "addressbook"
 

@@ -72,21 +72,18 @@ def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
 @register("Convolution", aliases=("convolution",))
 def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                  pad=(), num_filter=0, num_group=1, no_bias=False,
-                 layout="NCHW"):
+                 layout="NCHW", preferred_element_type=None):
+    """`preferred_element_type` widens the accumulator (int8 inputs with
+    an int32 accumulator engage the MXU's narrow-input path — the
+    quantized conv shares this body)."""
     lax = _lax()
     ndim = len(kernel) if kernel else weight.ndim - 2
     stride = stride or (1,) * ndim
     dilate = dilate or (1,) * ndim
     pad = pad or (0,) * ndim
-    if ndim == 1:
-        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
-                                        ("NCH", "OIH", "NCH"))
-    elif ndim == 2:
-        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
-                                        ("NCHW", "OIHW", "NCHW"))
-    else:
-        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
-                                        ("NCDHW", "OIDHW", "NCDHW"))
+    spec = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[ndim]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, spec)
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=tuple(stride),
@@ -94,6 +91,7 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         rhs_dilation=tuple(dilate),
         dimension_numbers=dn,
         feature_group_count=num_group,
+        preferred_element_type=preferred_element_type,
     )
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * ndim)

@@ -218,11 +218,47 @@ def run_failure(kv):
         raise AssertionError("barrier succeeded despite a dead worker")
 
 
+def run_server_restart(kv):
+    """Phase 1: train a few steps. Then signal, wait for the harness to
+    kill+restart the server, and verify the restored state continues
+    training (reference: server-side is_recovery, kvstore_dist.h:52-55).
+    Coordinated via marker files in MXNET_TEST_MARKER_DIR."""
+    import time
+
+    marker_dir = os.environ["MXNET_TEST_MARKER_DIR"]
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    w = mx.nd.ones((4,))
+    kv.init("w", w)
+    out = mx.nd.zeros((4,))
+    for _ in range(3):
+        kv.push("w", mx.nd.ones((4,)))       # grad = 1
+        kv.pull("w", out=out)
+    before = out.asnumpy().copy()
+    check(before, np.full(4, 1.0 - 0.5 * 3), "pre-restart value")
+
+    open(os.path.join(marker_dir, "phase1_done"), "w").close()
+    deadline = time.time() + 120
+    while not os.path.exists(os.path.join(marker_dir, "server_restarted")):
+        assert time.time() < deadline, "harness never restarted the server"
+        time.sleep(0.2)
+
+    # Restored state must be exactly the pre-kill value...
+    kv.pull("w", out=out)
+    check(out.asnumpy(), before, "restored value after server restart")
+    # ...and training continues through the recovered server.
+    for _ in range(2):
+        kv.push("w", mx.nd.ones((4,)))
+        kv.pull("w", out=out)
+    check(out.asnumpy(), before - 0.5 * 2, "post-restart training")
+    log("server restart recovery ok")
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--kv-type", default="dist_sync")
     parser.add_argument("--mode", default="kvstore",
-                        choices=["kvstore", "train", "failure"])
+                        choices=["kvstore", "train", "failure",
+                                 "server_restart"])
     args = parser.parse_args()
     print("creating kv", file=sys.stderr, flush=True)
     kv = mx.kv.create(args.kv_type)
@@ -231,6 +267,8 @@ def main():
     assert 0 <= kv.rank < kv.num_workers
     if args.mode == "failure":
         run_failure(kv)
+    elif args.mode == "server_restart":
+        run_server_restart(kv)
     elif args.mode == "train":
         run_train(kv)
     elif args.kv_type == "dist_async":

@@ -84,3 +84,57 @@ def test_two_bit_compression_codec():
                                atol=1e-6)
     # A saturated element (|g| >> t) keeps transferring ±t every round.
     assert dec2[1, 2] == -0.5
+
+
+def test_server_restart_recovery(tmp_path):
+    """Kill -9 a parameter server mid-training; a replacement started
+    with DMLC_SERVER_RECOVERY restores its snapshot and rejoins; the
+    worker reconnects through the scheduler and training continues
+    (reference: server-side is_recovery, kvstore_dist.h:52-55)."""
+    import subprocess
+    import time
+
+    from launch import _free_port
+
+    port = _free_port()
+    marker_dir = str(tmp_path)
+    base = dict(os.environ, **_ENV)
+    base.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "MXNET_PS_SNAPSHOT_DIR": marker_dir,
+        "MXNET_TEST_MARKER_DIR": marker_dir,
+    })
+    cmd = [sys.executable, _PROG, "--kv-type", "dist_sync",
+           "--mode", "server_restart"]
+
+    def spawn(role, extra=None):
+        env = dict(base, DMLC_ROLE=role)
+        env.update(extra or {})
+        return subprocess.Popen(cmd, env=env)
+
+    sched = spawn("scheduler")
+    server = spawn("server")
+    worker = spawn("worker")
+    try:
+        deadline = time.time() + 180
+        while not os.path.exists(os.path.join(marker_dir, "phase1_done")):
+            assert time.time() < deadline, "worker never finished phase 1"
+            assert worker.poll() is None, "worker died in phase 1"
+            time.sleep(0.2)
+        server.kill()                      # SIGKILL: no goodbye
+        server.wait(timeout=30)
+        server = spawn("server", {"DMLC_SERVER_RECOVERY": "0"})
+        open(os.path.join(marker_dir, "server_restarted"), "w").close()
+        assert worker.wait(timeout=180) == 0, "worker failed after restart"
+    finally:
+        for p in (worker, server, sched):
+            if p.poll() is None:
+                p.terminate()
+        for p in (worker, server, sched):
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -131,3 +131,62 @@ def test_train_step_bf16_mixed_precision():
         assert s.dtype == np.float32, (n, s.dtype)
     # Loss is fp32 and training progressed.
     assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_train_step_resnet_block_tp_state_equivalence():
+    """dp×tp == pure dp after FOUR steps, compared on the full training
+    state: parameters, momentum buffers, and BatchNorm running stats —
+    not just the loss trace (VERDICT r3 next #9)."""
+    from jax import tree_util as jtu
+
+    rng = np.random.RandomState(5)
+    X = rng.rand(16, 4, 8, 8).astype(np.float32)
+    Y = (np.arange(16) % 4).astype(np.float32)
+
+    def build():
+        mx.random.seed(11)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=4),
+                gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"),
+                gluon.nn.Conv2D(8, 3, padding=1, in_channels=8),
+                gluon.nn.BatchNorm(),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(4))
+        net.initialize(force_reinit=True)
+        return net
+
+    states = {}
+    for name, axes in [("dp", {"dp": 8}), ("tp", {"dp": 2, "tp": 4})]:
+        net = build()
+        step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9},
+                         mesh=make_mesh(axes))
+        for _ in range(4):
+            loss = step(X, Y)
+        states[name] = (jax.device_get(step._param_vals),
+                        jax.device_get(step._opt_state),
+                        jax.device_get(step._aux_vals),
+                        float(jax.device_get(loss)))
+
+    p_dp, m_dp, a_dp, l_dp = states["dp"]
+    p_tp, m_tp, a_tp, l_tp = states["tp"]
+    assert abs(l_dp - l_tp) < 2e-4 * max(1.0, abs(l_dp))
+    # block-scope counters differ between the two builds
+    # (conv0/conv2, ...), but sorted name order aligns structurally
+    for nd, nt in zip(sorted(p_dp), sorted(p_tp)):
+        np.testing.assert_allclose(p_dp[nd], p_tp[nt], rtol=2e-4,
+                                   atol=1e-5,
+                                   err_msg="param %s/%s" % (nd, nt))
+    for nd, nt in zip(sorted(a_dp), sorted(a_tp)):
+        np.testing.assert_allclose(a_dp[nd], a_tp[nt], rtol=2e-4,
+                                   atol=1e-5,
+                                   err_msg="aux %s/%s" % (nd, nt))
+    flat_dp = jtu.tree_leaves(m_dp)
+    flat_tp = jtu.tree_leaves(m_tp)
+    assert len(flat_dp) == len(flat_tp) and flat_dp
+    for i, (a, b) in enumerate(zip(flat_dp, flat_tp)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5,
+                                   err_msg="momentum leaf %d" % i)

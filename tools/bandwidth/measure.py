@@ -63,18 +63,106 @@ def measure(kv_type="device", num_devices=2, sizes=(1024 * 1024,),
     return results
 
 
+def measure_dist(sizes=(1024 * 1024,), repeat=5, warmup=2,
+                 num_servers=2):
+    """Bandwidth of the multi-process parameter-server path: spawns a
+    local scheduler + servers (tools/launch.py plumbing) and measures
+    single-worker push+pull rounds over the TCP/DCN transport. Returns
+    [(size, avg_seconds, GB/s)]."""
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    import subprocess
+
+    from launch import _free_port
+
+    port = _free_port()
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1",
+               DMLC_PS_ROOT_PORT=str(port),
+               DMLC_NUM_WORKER="1",
+               DMLC_NUM_SERVER=str(num_servers),
+               JAX_PLATFORMS="cpu")
+    procs = []
+    sched_env = dict(env, DMLC_ROLE="scheduler")
+    procs.append(subprocess.Popen(
+        [sys.executable, "-c",
+         "import mxnet_tpu.kvstore_server as s; s._init_kvstore_server_module()"],
+        env=sched_env, cwd=root))
+    for _ in range(num_servers):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             "import mxnet_tpu.kvstore_server as s; s._init_kvstore_server_module()"],
+            env=dict(env, DMLC_ROLE="server"), cwd=root))
+    os.environ.update({k: env[k] for k in
+                       ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT",
+                        "DMLC_NUM_WORKER", "DMLC_NUM_SERVER")})
+    os.environ["DMLC_ROLE"] = "worker"
+    from mxnet_tpu.util import pin_platform
+
+    pin_platform("cpu")       # this measures DCN transport, not the chip
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    results = []
+    try:
+        for size in sizes:
+            key = "b%d" % size
+            kv.init(key, mx.nd.zeros((size,)))
+            val = mx.nd.ones((size,))
+            out = mx.nd.zeros((size,))
+
+            def round_trip():
+                kv.push(key, val)
+                kv.pull(key, out=out)
+                return float(out.asnumpy()[0])
+
+            for _ in range(warmup):
+                round_trip()
+            t0 = time.perf_counter()
+            for _ in range(repeat):
+                round_trip()
+            dt = (time.perf_counter() - t0) / repeat
+            gbs = (2 * size * 4) / dt / 1e9   # pushed + pulled bytes
+            results.append((size, dt, gbs))
+    finally:
+        kv.close()
+        # scheduler/server teardown is best-effort (launch_local does
+        # the same): shutdown delivery races scheduler exit by design.
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.terminate()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    return results
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="measure kvstore communication cost",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
-    parser.add_argument("--kv-store", default="device")
+    parser.add_argument("--kv-store", default="device",
+                        help="device/local, or dist for the "
+                        "multi-process parameter-server path")
     parser.add_argument("--num-devices", type=int, default=2)
+    parser.add_argument("--num-servers", type=int, default=2)
     parser.add_argument("--sizes", default="262144,1048576,4194304",
                         help="comma-separated float32 element counts")
     parser.add_argument("--repeat", type=int, default=5)
     args = parser.parse_args()
     sizes = [int(s) for s in args.sizes.split(",")]
-    rows = measure(args.kv_store, args.num_devices, sizes, args.repeat)
+    if args.kv_store.startswith("dist"):
+        rows = measure_dist(sizes, args.repeat,
+                            num_servers=args.num_servers)
+    else:
+        rows = measure(args.kv_store, args.num_devices, sizes,
+                       args.repeat)
     print("%12s %12s %10s" % ("elements", "sec/round", "GB/s"))
     for size, dt, gbs in rows:
         print("%12d %12.6f %10.3f" % (size, dt, gbs))

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""int8-vs-fp32 layer microbenchmark (VERDICT r3 weak #5 follow-up:
+measure whether the `preferred_element_type=int32` int8 contraction
+actually beats fp32 on the MXU).
+
+Times a ResNet-50-shaped conv (256x14x14, 3x3/256) and a classifier FC
+(2048->1000) in fp32 vs the quantized int8 path, one JSON line each.
+Runs on whatever backend is up (pass --device cpu to pin; numbers only
+mean anything on the chip).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _median_time(fn, *args, iters=20, windows=5):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        rates.append((time.perf_counter() - t0) / iters)
+    return sorted(rates)[len(rates) // 2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "cpu", "tpu"])
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--channels", type=int, default=256,
+                    help="conv width (drop for cpu smoke runs)")
+    args = ap.parse_args()
+    from mxnet_tpu.util import pin_platform
+
+    pin_platform(args.device)
+
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import _convolution
+    from mxnet_tpu.ops.quantization_ops import (_quantized_conv,
+                                                _quantized_fc)
+
+    rng = np.random.RandomState(0)
+    b = args.batch
+    ch = args.channels
+
+    # conv: 256 -> 256, 3x3 on 14x14 (ResNet-50 stage-4 shape)
+    x = jnp.asarray(rng.rand(b, ch, 14, 14).astype(np.float32))
+    wf = jnp.asarray((rng.randn(ch, ch, 3, 3) * 0.05)
+                     .astype(np.float32))
+    wq = jnp.clip(jnp.round(wf * 127 / jnp.abs(wf).max()),
+                  -127, 127).astype(jnp.int8)
+    f32 = jax.jit(lambda a, w: _convolution(
+        a, w, None, kernel=(3, 3), pad=(1, 1), num_filter=ch,
+        no_bias=True))
+    i8 = jax.jit(lambda a, w: _quantized_conv(
+        a, w, kernel=(3, 3), pad=(1, 1), num_filter=ch, no_bias=True,
+        min_data=-3.0, max_data=3.0, w_scale=127.0 / 0.25))
+    t_f = _median_time(f32, x, wf, iters=args.iters)
+    t_q = _median_time(i8, x, wq, iters=args.iters)
+    print(json.dumps({"metric": "conv3x3_int8_speedup",
+                      "value": round(t_f / t_q, 4), "unit": "x",
+                      "fp32_ms": round(t_f * 1e3, 3),
+                      "int8_ms": round(t_q * 1e3, 3),
+                      "vs_baseline": round(t_f / t_q, 4)}), flush=True)
+
+    # FC: 2048 -> 1000 (classifier shape)
+    xf = jnp.asarray(rng.rand(b, 2048).astype(np.float32))
+    wf2 = jnp.asarray((rng.randn(1000, 2048) * 0.05).astype(np.float32))
+    wq2 = jnp.clip(jnp.round(wf2 * 127 / jnp.abs(wf2).max()),
+                   -127, 127).astype(jnp.int8)
+    f32fc = jax.jit(lambda a, w: a @ w.T)
+    i8fc = jax.jit(lambda a, w: _quantized_fc(
+        a, w, num_hidden=1000, no_bias=True, min_data=-3.0,
+        max_data=3.0, w_scale=127.0 / 0.25))
+    t_f = _median_time(f32fc, xf, wf2, iters=args.iters)
+    t_q = _median_time(i8fc, xf, wq2, iters=args.iters)
+    print(json.dumps({"metric": "fc2048x1000_int8_speedup",
+                      "value": round(t_f / t_q, 4), "unit": "x",
+                      "fp32_ms": round(t_f * 1e3, 3),
+                      "int8_ms": round(t_q * 1e3, 3),
+                      "vs_baseline": round(t_f / t_q, 4)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
