@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Bucketed LSTM word-language-model driver — the reference's LSTM PTB
+tracked config (BASELINE.md; reference example/rnn/bucketing/
+lstm_bucketing.py): tokenize text, BucketSentenceIter over sentence
+buckets, Embedding + stacked fused LSTM + softmax via sym_gen, trained
+with BucketingModule.fit and a Perplexity metric.
+
+TPU rebuild: each bucket length is ONE cached XLA executable (the
+bucketing-as-executable-cache design, README); the fused LSTM is a
+`lax.scan` op. With ``--synthetic`` (or no data file) the driver builds
+a Markov-chain corpus so zero-egress environments exercise the exact
+training path the reference measures on sherlockholmes/PTB data.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    """(reference lstm_bucketing.py:tokenize_text)."""
+    lines = open(fname).readlines()
+    lines = [[w for w in line.split(" ") if w] for line in lines]
+    sentences, vocab = mx.rnn.encode_sentences(
+        lines, vocab=vocab, invalid_label=invalid_label,
+        start_label=start_label)
+    return sentences, vocab
+
+
+def synthetic_corpus(args, rng):
+    """Markov-chain sentences: structure for the LM to learn."""
+    V = args.vocab_size
+    trans = rng.dirichlet(np.ones(V) * 0.08, size=V)
+    sents = []
+    for _ in range(args.num_sentences):
+        n = rng.choice(args.buckets)
+        w = rng.randint(1, V)
+        out = [w]
+        for _ in range(n - 1):
+            w = rng.choice(V, p=trans[w])
+            out.append(int(w))
+        sents.append(out)
+    return sents
+
+
+def sym_gen_factory(args, vocab_size):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_layers):
+            stack.add(mx.rnn.FusedRNNCell(args.num_hidden, num_layers=1,
+                                          mode="lstm",
+                                          prefix="lstm_l%d_" % i))
+        outputs, _ = stack.unroll(seq_len, inputs=embed, layout="NTC",
+                                  merge_outputs=True)
+        pred = mx.sym.reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        label_flat = mx.sym.reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, label_flat, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    return sym_gen
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Train a bucketed LSTM LM (reference lstm_bucketing)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--optimizer", default="adam")
+    parser.add_argument("--mom", type=float, default=0.0)
+    parser.add_argument("--wd", type=float, default=1e-5)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--disp-batches", type=int, default=50)
+    parser.add_argument("--buckets", default="10,20,30,40",
+                        help="comma-separated bucket lengths")
+    parser.add_argument("--train-data", default=None,
+                        help="tokenized text file (one sentence/line)")
+    parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--vocab-size", type=int, default=200,
+                        help="synthetic corpus vocabulary")
+    parser.add_argument("--num-sentences", type=int, default=2000)
+    parser.add_argument("--device", default=os.environ.get(
+        "MXNET_DEVICE", "auto"), choices=["auto", "cpu", "tpu"])
+    args = parser.parse_args()
+    mx.util.pin_platform(args.device)
+    logging.basicConfig(level=logging.INFO)
+    args.buckets = [int(b) for b in args.buckets.split(",")]
+
+    if args.train_data and os.path.isfile(args.train_data):
+        sents, vocab = tokenize_text(args.train_data, start_label=1,
+                                     invalid_label=0)
+        vocab_size = len(vocab) + 1
+    else:
+        rng = np.random.RandomState(0)
+        sents = synthetic_corpus(args, rng)
+        vocab_size = args.vocab_size
+    # BucketSentenceIter produces next-token labels internally (input
+    # shifted one step; padding slots get invalid_label=0).
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=args.batch_size,
+                                   buckets=args.buckets, invalid_label=0)
+
+    kv = mx.kv.create(args.kv_store)
+    mod = mx.mod.BucketingModule(
+        sym_gen_factory(args, vocab_size),
+        default_bucket_key=max(args.buckets),
+        context=mx.tpu(0) if args.device != "cpu" and mx.num_tpus()
+        else mx.cpu())
+    mod.fit(it, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.Perplexity(ignore_label=0),
+            kvstore=kv, optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr,
+                              "wd": args.wd} if args.optimizer != "sgd"
+            else {"learning_rate": args.lr, "momentum": args.mom,
+                  "wd": args.wd},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches))
+    it.reset()
+    ppl = mod.score(it, mx.metric.Perplexity(ignore_label=0))[0][1]
+    logging.info("final train perplexity: %.2f", ppl)
+    print("final-perplexity %.4f" % ppl)
+    if hasattr(kv, "close"):
+        kv.close()
+    return ppl
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,13 @@ CATALOGUE = [
          "servers", False),
     Knob("MXNET_KVSTORE_DEBUG", int, 0, "kvstore_server.py",
          "verbose parameter-server tracing", False),
+    Knob("MXNET_SUBGRAPH_BACKEND", str, "", "subgraph.py",
+         "auto-partition bound graphs with this registered subgraph "
+         "backend (reference build_subgraph pass)", False),
+    Knob("MXNET_PS_SNAPSHOT_DIR", str, "", "kvstore_server.py",
+         "server recovery: per-key shard snapshots live here", False),
+    Knob("MXNET_PS_SNAPSHOT_EVERY", int, 1, "kvstore_server.py",
+         "applies between optimizer-state meta snapshots", False),
     Knob("MXNET_TPU_PS_TIMEOUT", float, 300.0, "kvstore_server.py",
          "dist rendezvous/barrier/pull timeout in seconds", False),
     Knob("MXNET_TPU_PS_AUTHKEY", str, "mxnet_tpu_kvstore",

@@ -40,6 +40,25 @@ class Executor:
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
                  grad_req="write", aux_states=None, group2ctx=None,
                  shared_exec=None):
+        import os as _os
+
+        backend = _os.environ.get("MXNET_SUBGRAPH_BACKEND")
+        if backend:
+            # Auto-partition at bind like the reference's
+            # MXNET_SUBGRAPH_BACKEND build_subgraph pass; unknown names
+            # warn and continue (reference behavior).
+            from . import subgraph as _subgraph
+
+            if backend in _subgraph.list_backends():
+                symbol = _subgraph.partition(symbol, backend)
+            else:
+                import logging
+
+                logging.warning(
+                    "MXNET_SUBGRAPH_BACKEND=%r is not a registered "
+                    "subgraph backend (registered: %s); binding "
+                    "without partitioning", backend,
+                    _subgraph.list_backends())
         self._symbol = symbol
         self._ctx = ctx
         self.arg_names = symbol.list_arguments()
@@ -142,6 +161,24 @@ class Executor:
                 val = arg_map[node._name] if node._name in arg_map \
                     else aux_map[node._name]
                 results[key] = val
+                return val
+            if node._op == "_subgraph":
+                # Partitioned fragment (mxnet_tpu/subgraph.py): custom
+                # backend fn if provided (e.g. a Pallas kernel), else
+                # evaluate the embedded sub-DAG — always semantics-
+                # preserving.
+                in_vals = [value_of(i, i._out_index or 0)
+                           for i in node._inputs]
+                fn = getattr(node, "_sub_fn", None)
+                if fn is not None:
+                    val = fn(*in_vals)
+                else:
+                    sub_map = dict(zip(node._sub_arg_names, in_vals))
+                    sub_outs, _ = self._eval_graph(sub_map, {},
+                                                   node._sub_sym.outputs)
+                    val = sub_outs[0]
+                results[(node._uid, 0)] = val
+                results[(node._uid, None)] = val
                 return val
             op_name = node._attrs.get("_op_name", node._op)
             op = _registry.get(op_name)

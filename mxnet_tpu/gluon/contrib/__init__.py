@@ -6,5 +6,6 @@ VariationalDropout and convolutional RNN cells, data samplers).
 from . import nn
 from . import rnn
 from . import data
+from . import loss
 
-__all__ = ["nn", "rnn", "data"]
+__all__ = ["nn", "rnn", "data", "loss"]

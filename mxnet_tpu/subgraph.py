@@ -1,0 +1,240 @@
+"""Subgraph partitioning — the "hand this fragment to a backend" hook.
+
+Reference: src/operator/subgraph/subgraph_property.h (SubgraphProperty +
+SubgraphSelector: walk the graph, select connected op sets, replace each
+with a subgraph node executed by a backend) and
+MXNET_SUBGRAPH_BACKEND / partition_graph.
+
+TPU rebuild: a matched fragment becomes ONE `_subgraph` node whose
+FCompute is a user-supplied jax function — the natural payload is a
+Pallas kernel (`mxnet_tpu.rtc.PallasModule`), giving hand-written TPU
+kernels a graph-level story: match the fragment, swap in the kernel,
+keep the rest of the graph untouched. Without a custom fn the node
+falls back to evaluating its embedded sub-DAG, so partitioning is
+always semantics-preserving.
+
+API (mirrors the reference's registration workflow):
+
+    class FuseDenseRelu(subgraph.SubgraphProperty):
+        def select(self, node): return node.op == "Activation"
+        def select_input(self, node, inp): return inp.op == "FullyConnected"
+        def create_fn(self, sub_sym, arg_names):
+            def fused(x, w, b):  # e.g. a Pallas kernel
+                ...
+            return fused
+
+    subgraph.register_backend("dense_relu", FuseDenseRelu())
+    psym = subgraph.partition(sym, "dense_relu")   # or property instance
+    psym.bind(...).forward(...)
+"""
+from __future__ import annotations
+
+__all__ = ["SubgraphSelector", "SubgraphProperty", "register_backend",
+           "list_backends", "partition"]
+
+_BACKENDS: dict[str, "SubgraphProperty"] = {}
+
+
+class SubgraphSelector:
+    """Decides which nodes join a selection (reference
+    subgraph_property.h:SubgraphSelector). Default: nothing."""
+
+    def select(self, node):
+        """Start a selection at this node?"""
+        return False
+
+    def select_input(self, node, input_node):
+        """Grow the selection from `node` into its producer?"""
+        return False
+
+
+class SubgraphProperty(SubgraphSelector):
+    """A backend: selection rules + the replacement executor
+    (reference subgraph_property.h:SubgraphProperty). Subclasses
+    override the selector methods and (optionally) `create_fn`."""
+
+    name = None
+
+    def create_fn(self, sub_sym, arg_names):
+        """Return a jax callable `fn(*arg_values) -> value` replacing
+        the fragment, or None to keep the embedded sub-DAG as the
+        executor (still useful: the fragment is isolated for inspection
+        and can be re-targeted later)."""
+        return None
+
+
+def register_backend(name, prop):
+    """Register a property under a backend name (reference
+    MXNET_SUBGRAPH_BACKEND names)."""
+    prop.name = name
+    _BACKENDS[name] = prop
+    return prop
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def _resolve(backend):
+    if isinstance(backend, SubgraphProperty):
+        return backend
+    try:
+        return _BACKENDS[backend]
+    except KeyError:
+        raise ValueError("unknown subgraph backend %r; registered: %s"
+                         % (backend, list_backends())) from None
+
+
+def partition(symbol, backend):
+    """Replace every maximal matched fragment of `symbol` with a
+    `_subgraph` node (reference build_subgraph pass).
+
+    Selection walks each seed node's INPUT chain while
+    `select_input` approves; the fragment must be single-output (the
+    seed). Returns a new Symbol sharing unmatched nodes."""
+    from .symbol import Symbol
+
+    prop = _resolve(backend)
+    out_syms = symbol.outputs if symbol._op == "_group" else [symbol]
+
+    # Count consumers so fragments never swallow a node whose value is
+    # also needed outside the fragment.
+    consumers: dict[int, int] = {}
+    for node in symbol._topo():
+        for inp in node._inputs:
+            consumers[inp._uid] = consumers.get(inp._uid, 0) + 1
+    for s in out_syms:
+        consumers[s._uid] = consumers.get(s._uid, 0) + 1
+
+    # Clones keyed by PRODUCER uid: multi-output views share their
+    # producer's uid and differ only in _out_index, so per-view keying
+    # would alias them onto one slot.
+    base_clones: dict[int, Symbol] = {}
+    _UNCHANGED = object()
+
+    def _fusable(node):
+        """Fragment members must be single-output, stateless ops:
+        multi-output views and aux-consuming ops (BatchNorm moving
+        stats) are excluded — aux writes inside a fragment would be
+        silently dropped."""
+        return (node._num_outputs == 1 and node._out_index is None
+                and not any(i._op is None and i._is_aux
+                            for i in node._inputs))
+
+    def grow(seed):
+        """Collect the fragment rooted at `seed` (seed + approved
+        producer chain, each interior node consumed only inside)."""
+        members = {seed._uid}
+        order = [seed]
+        frontier = [seed]
+        while frontier:
+            node = frontier.pop()
+            for inp in node._inputs:
+                if inp._uid in members or inp._op is None:
+                    continue
+                if not _fusable(inp) or not prop.select_input(node, inp):
+                    continue
+                if consumers.get(inp._uid, 0) > 1:
+                    continue          # value visible outside the fragment
+                members.add(inp._uid)
+                order.append(inp)
+                frontier.append(inp)
+        return members, order
+
+    def rebuild_base(node):
+        """Clone (or mark unchanged) the producer behind `node`."""
+        hit = base_clones.get(node._uid)
+        if hit is not None:
+            return hit
+        if prop.select(node) and _fusable(node):
+            members, order = grow(node)
+            if len(order) > 1:        # only fuse real fragments
+                new = _make_subgraph_node(node, members)
+                base_clones[node._uid] = new
+                return new
+        new_inputs = [rebuild(i) for i in node._inputs]
+        if all(a is b for a, b in zip(new_inputs, node._inputs)):
+            base_clones[node._uid] = _UNCHANGED
+            return _UNCHANGED
+        clone = Symbol(node._op, attrs=dict(node._attrs),
+                       inputs=new_inputs, name=node._name,
+                       num_outputs=node._num_outputs)
+        # a re-cloned _subgraph node keeps its executor payload
+        for attr in ("_sub_sym", "_sub_arg_names", "_sub_fn"):
+            if hasattr(node, attr):
+                setattr(clone, attr, getattr(node, attr))
+        base_clones[node._uid] = clone
+        return clone
+
+    def rebuild(node):
+        if node._op is None:
+            return node
+        base = rebuild_base(node)
+        if base is _UNCHANGED:
+            return node
+        if node._out_index is not None:
+            return base[node._out_index]
+        return base
+
+    def _make_subgraph_node(seed, members):
+        # External inputs: every edge crossing into the fragment, in
+        # first-use order; they become the _subgraph node's inputs and
+        # the sub-DAG's free variables. Views are distinct values, so
+        # dedup by (uid, out_index).
+        ext, seen = [], set()
+
+        def scan(node):
+            for inp in node._inputs:
+                if inp._uid in members:
+                    scan(inp)
+                else:
+                    key = (inp._uid, inp._out_index)
+                    if key not in seen:
+                        seen.add(key)
+                        ext.append(inp)
+
+        scan(seed)
+        arg_names = []
+        var_of = {}
+        for i, e in enumerate(ext):
+            nm = e._name if e._op is None else "sub_in%d" % i
+            arg_names.append(nm)
+            var_of[(e._uid, e._out_index)] = Symbol(None, name=nm)
+
+        # Clone the fragment against the placeholder variables
+        # (members are single-output by _fusable, so a flat uid cache
+        # is safe here).
+        inner_cache = {}
+
+        def clone_inner(node):
+            ph = var_of.get((node._uid, node._out_index))
+            if ph is not None:
+                return ph
+            got = inner_cache.get(node._uid)
+            if got is not None:
+                return got
+            c = Symbol(node._op, attrs=dict(node._attrs),
+                       inputs=[clone_inner(i) for i in node._inputs],
+                       name=node._name, num_outputs=node._num_outputs)
+            inner_cache[node._uid] = c
+            return c
+
+        sub_sym = clone_inner(seed)
+        new_inputs = [rebuild(e) for e in ext]
+        node = Symbol("_subgraph",
+                      attrs={"_op_name": "_subgraph",
+                             "__subgraph_backend__": prop.name or
+                             type(prop).__name__},
+                      inputs=new_inputs,
+                      name="%s_subgraph" % (seed._name or "fused"))
+        node._sub_sym = sub_sym
+        node._sub_arg_names = list(arg_names)
+        node._sub_fn = prop.create_fn(sub_sym, list(arg_names))
+        return node
+
+    new_outs = [rebuild(s) for s in out_syms]
+    if symbol._op == "_group":
+        from . import symbol as _symmod
+
+        return _symmod.Group(new_outs)
+    return new_outs[0]

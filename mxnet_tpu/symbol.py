@@ -394,6 +394,27 @@ class Symbol:
                                 if inp._name.endswith("_" + pname) or \
                                         inp._name == pname:
                                     shapes.setdefault(inp._name, pshape)
+            if node._op == "_subgraph":
+                # Partitioned fragment (mxnet_tpu/subgraph.py): recurse
+                # with whatever external shapes are known — param-rule
+                # shapes for ops INSIDE the fragment are discovered by
+                # the inner pass and propagated back to the outer vars.
+                sub_known = {}
+                for nm, inp in zip(node._sub_arg_names, node._inputs):
+                    s = shapes.get(inp._name) if inp._op is None else \
+                        shapes.get(("out", inp._uid,
+                                    inp._out_index or 0))
+                    if s is not None:
+                        sub_known[nm] = tuple(s)
+                sub = node._sub_sym._infer_all_shapes(sub_known)
+                for nm, inp in zip(node._sub_arg_names, node._inputs):
+                    if inp._op is None and nm in sub:
+                        shapes.setdefault(inp._name, tuple(sub[nm]))
+                s = sub[("out", node._sub_sym._uid,
+                         node._sub_sym._out_index or 0)]
+                shapes[("out", node._uid, 0)] = tuple(s)
+                shapes[("out", node._uid, None)] = tuple(s)
+                continue
             # now eval_shape the node if all inputs known
             in_shapes = []
             ok = True

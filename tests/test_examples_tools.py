@@ -84,3 +84,29 @@ def test_bandwidth_measure():
     assert len(rows) == 1
     size, dt, gbs = rows[0]
     assert dt > 0 and gbs > 0
+
+
+def test_train_rnn_lm_synthetic():
+    """The LSTM PTB-style tracked config as a runnable driver
+    (BASELINE.md; reference example/rnn/bucketing/lstm_bucketing.py)."""
+    out = _run([sys.executable, "examples/train_rnn_lm.py", "--synthetic",
+                "--num-sentences", "400", "--vocab-size", "50",
+                "--num-hidden", "32", "--num-embed", "16",
+                "--num-layers", "1", "--buckets", "6,10",
+                "--batch-size", "16", "--num-epochs", "4"], timeout=500)
+    line = [l for l in out.splitlines()
+            if l.startswith("final-perplexity")]
+    assert line, out
+    # uniform guessing over the 50-word vocab would be ppl 50
+    assert float(line[0].split()[1]) < 30
+
+
+def test_train_ssd_synthetic():
+    """The SSD tracked config as a runnable driver (BASELINE.md;
+    reference example/ssd/train.py)."""
+    out = _run([sys.executable, "examples/train_ssd.py",
+                "--num-examples", "128", "--num-epochs", "8",
+                "--batch-size", "16"], timeout=500)
+    line = [l for l in out.splitlines() if l.startswith("final-loss")]
+    assert line, out
+    assert float(line[0].split()[3]) > 0.5, "recall too low: %s" % line

@@ -1,0 +1,271 @@
+"""Subgraph partition extension point (reference
+src/operator/subgraph/subgraph_property.h) and contrib NCE loss
+(reference example/nce-loss)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, subgraph
+
+
+def _dense_relu_sym():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="act")
+    return mx.sym.FullyConnected(act, num_hidden=3, name="out")
+
+
+class FuseDenseRelu(subgraph.SubgraphProperty):
+    """Fuse Activation(FullyConnected) into one custom region."""
+
+    def __init__(self, with_fn=True):
+        self.calls = []
+        self._with_fn = with_fn
+
+    def select(self, node):
+        return node._op == "Activation"
+
+    def select_input(self, node, inp):
+        return inp._op == "FullyConnected"
+
+    def create_fn(self, sub_sym, arg_names):
+        if not self._with_fn:
+            return None
+        calls = self.calls
+
+        def fused(x, w, b):
+            import jax.numpy as jnp
+
+            calls.append(arg_names)
+            return jnp.maximum(x @ w.T + b, 0.0)
+
+        return fused
+
+
+def _run_sym(sym, x, params):
+    args = dict(params)
+    args["data"] = mx.nd.array(x)
+    ex = sym.bind(args={k: (v if isinstance(v, mx.nd.NDArray)
+                            else mx.nd.array(v)) for k, v in args.items()},
+                  grad_req="null")
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def _init_params(sym, x):
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=x.shape)
+    return {n: rng.randn(*s).astype(np.float32) * 0.3
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n != "data"}
+
+
+def test_partition_custom_fn_runs_and_matches():
+    sym = _dense_relu_sym()
+    x = np.random.RandomState(1).rand(4, 6).astype(np.float32)
+    params = _init_params(sym, x)
+    want = _run_sym(sym, x, params)
+
+    prop = subgraph.register_backend("dense_relu_fused", FuseDenseRelu())
+    psym = subgraph.partition(sym, "dense_relu_fused")
+    got = _run_sym(psym, x, params)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert prop.calls, "custom fused fn never ran"
+    # the fragment saw (data-in, weight, bias)
+    assert len(prop.calls[0]) == 3
+    # graph structure: an actual _subgraph node exists
+    assert any(n._op == "_subgraph" for n in psym._topo())
+
+
+def test_partition_fallback_evaluates_subdag():
+    sym = _dense_relu_sym()
+    x = np.random.RandomState(2).rand(5, 6).astype(np.float32)
+    params = _init_params(sym, x)
+    want = _run_sym(sym, x, params)
+    psym = subgraph.partition(sym, FuseDenseRelu(with_fn=False))
+    got = _run_sym(psym, x, params)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert any(n._op == "_subgraph" for n in psym._topo())
+
+
+def test_partition_respects_external_consumers():
+    """A producer consumed outside the fragment must NOT be fused."""
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="act")
+    # fc's value is ALSO used directly — fusing it away would break this
+    both = act + fc
+    psym = subgraph.partition(both, FuseDenseRelu(with_fn=False))
+    # fragment collapsed to just the Activation seed -> no fusion
+    assert not any(n._op == "_subgraph" for n in psym._topo())
+    x = np.random.RandomState(3).rand(2, 6).astype(np.float32)
+    params = _init_params(both, x)
+    np.testing.assert_allclose(_run_sym(psym, x, params),
+                               _run_sym(both, x, params), rtol=1e-5)
+
+
+def test_partition_pallas_backend():
+    """The rtc story: a Pallas kernel (interpret mode on cpu) as the
+    fused region's executor."""
+    import functools
+
+    class PallasDenseRelu(FuseDenseRelu):
+        def create_fn(self, sub_sym, arg_names):
+            from mxnet_tpu import rtc
+
+            def relu_kernel(x_ref, o_ref):
+                o_ref[:] = jnp_max(x_ref[:], 0.0)
+
+            import jax.numpy as jnp
+
+            def jnp_max(a, b):
+                return jnp.maximum(a, b)
+
+            mod = rtc.PallasModule(fused_relu=relu_kernel)
+            k = mod.get_kernel("fused_relu")
+
+            def fused(x, w, b):
+                from mxnet_tpu.ndarray.ndarray import NDArray
+
+                pre = x @ w.T + b           # MXU matmul
+                return k.launch([NDArray(pre)])._data
+
+            return fused
+
+    sym = _dense_relu_sym()
+    x = np.random.RandomState(4).rand(4, 6).astype(np.float32)
+    params = _init_params(sym, x)
+    want = _run_sym(sym, x, params)
+    got = _run_sym(subgraph.partition(sym, PallasDenseRelu()), x, params)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+# -- NCE ----------------------------------------------------------------------
+
+def test_nce_loss_matches_manual():
+    from mxnet_tpu.gluon.contrib.loss import NCELoss
+
+    rng = np.random.RandomState(5)
+    B, D, V, K = 6, 8, 40, 4
+    embed = rng.randn(B, D).astype(np.float32)
+    weight = (rng.randn(V, D) * 0.2).astype(np.float32)
+    bias = (rng.randn(V) * 0.1).astype(np.float32)
+    label = rng.randint(0, V, B).astype(np.float32)
+    noise = rng.randint(0, V, (B, K)).astype(np.float32)
+
+    loss = NCELoss(num_sampled=K, num_classes=V)
+    got = loss(mx.nd.array(embed), mx.nd.array(weight),
+               mx.nd.array(bias), mx.nd.array(label),
+               mx.nd.array(noise)).asnumpy()
+
+    def sigm(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    want = np.zeros(B, np.float32)
+    for i in range(B):
+        st = embed[i] @ weight[int(label[i])] + bias[int(label[i])]
+        want[i] = -np.log(sigm(st))
+        for j in range(K):
+            sn = embed[i] @ weight[int(noise[i, j])] + \
+                bias[int(noise[i, j])]
+            want[i] -= np.log(1 - sigm(sn))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_nce_trains_large_vocab_classifier():
+    """NCE-trained output embedding separates true classes from noise
+    without ever computing a |V|-wide softmax."""
+    from mxnet_tpu.gluon.contrib.loss import NCELoss
+
+    rng = np.random.RandomState(6)
+    B, D, V, K = 32, 16, 100, 8
+    # each class has a prototype; embeddings near prototype => class
+    protos = rng.randn(V, D).astype(np.float32)
+    loss_fn = NCELoss(num_sampled=K, num_classes=V)
+
+    class Model(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.params.get("out_weight", shape=(V, D))
+            self.bias = self.params.get("out_bias", shape=(V,))
+
+        def hybrid_forward(self, F, embed, label, noise, weight, bias):
+            return loss_fn(embed, weight, bias, label, noise)
+
+    model = Model()
+    model.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    first = last = None
+    for step in range(60):
+        y = rng.randint(0, V, B)
+        x = protos[y] + 0.1 * rng.randn(B, D).astype(np.float32)
+        noise = rng.randint(0, V, (B, K))
+        with autograd.record():
+            l = model(mx.nd.array(x), mx.nd.array(y.astype(np.float32)),
+                      mx.nd.array(noise.astype(np.float32))).mean()
+        l.backward()
+        trainer.step(B)
+        last = float(l.asnumpy().ravel()[0])
+        if first is None:
+            first = last
+    assert last < first * 0.6, "NCE loss %.4f -> %.4f" % (first, last)
+
+
+def test_env_subgraph_backend_autopartitions():
+    """MXNET_SUBGRAPH_BACKEND partitions at bind (reference
+    build_subgraph env pass)."""
+    import os
+
+    prop = subgraph.register_backend("autotest_fuse", FuseDenseRelu())
+    sym = _dense_relu_sym()
+    x = np.random.RandomState(7).rand(3, 6).astype(np.float32)
+    params = _init_params(sym, x)
+    want = _run_sym(sym, x, params)
+    os.environ["MXNET_SUBGRAPH_BACKEND"] = "autotest_fuse"
+    try:
+        got = _run_sym(sym, x, params)   # bind partitions internally
+    finally:
+        del os.environ["MXNET_SUBGRAPH_BACKEND"]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert prop.calls, "env-selected backend never ran"
+
+
+def test_partition_preserves_multi_output_views():
+    """Multi-output views (shared producer uid, distinct out_index)
+    upstream of a fused fragment must keep their slots."""
+    data = mx.sym.var("data")
+    a, bpart = mx.sym.split(data, num_outputs=2, axis=1)
+    fc = mx.sym.FullyConnected(a, num_hidden=4, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="act")
+    out = act + mx.sym.sum(bpart, axis=1, keepdims=True)
+    x = np.random.RandomState(8).rand(3, 6).astype(np.float32)
+    params = _init_params(out, x)
+    want = _run_sym(out, x, params)
+    psym = subgraph.partition(out, FuseDenseRelu())
+    assert any(n._op == "_subgraph" for n in psym._topo())
+    got = _run_sym(psym, x, params)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_partition_shape_inference_through_subgraph():
+    sym = _dense_relu_sym()
+    psym = subgraph.partition(sym, FuseDenseRelu(with_fn=False))
+    shapes, out_shapes, _ = psym.infer_shape(data=(4, 6))
+    assert out_shapes[0] == (4, 3)
+
+
+def test_partition_excludes_batchnorm_fragments():
+    """Aux-consuming ops never join a fragment (their moving-stat
+    writes would be dropped)."""
+    class GreedyFuse(FuseDenseRelu):
+        def select_input(self, node, inp):
+            return True               # try to swallow everything
+
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    bn = mx.sym.BatchNorm(fc, name="bn")
+    act = mx.sym.Activation(bn, act_type="relu", name="act")
+    psym = subgraph.partition(act, GreedyFuse(with_fn=False))
+    for n in psym._topo():
+        if n._op == "_subgraph":
+            inner_ops = {m._op for m in n._sub_sym._topo()}
+            assert "BatchNorm" not in inner_ops
