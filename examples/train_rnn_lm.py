@@ -36,17 +36,19 @@ def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
 
 
 def synthetic_corpus(args, rng):
-    """Markov-chain sentences: structure for the LM to learn."""
+    """Markov-chain sentences: structure for the LM to learn. Token id
+    0 is RESERVED for padding (invalid_label) — real tokens are
+    1..V-1, mirroring the real-data path's start_label=1."""
     V = args.vocab_size
-    trans = rng.dirichlet(np.ones(V) * 0.08, size=V)
+    trans = rng.dirichlet(np.ones(V - 1) * 0.08, size=V - 1)
     sents = []
     for _ in range(args.num_sentences):
         n = rng.choice(args.buckets)
         w = rng.randint(1, V)
         out = [w]
         for _ in range(n - 1):
-            w = rng.choice(V, p=trans[w])
-            out.append(int(w))
+            w = 1 + int(rng.choice(V - 1, p=trans[w - 1]))
+            out.append(w)
         sents.append(out)
     return sents
 

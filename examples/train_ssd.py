@@ -125,7 +125,9 @@ def train(args):
                                        0.5 * adiff * adiff).mean()
                 loss = cls_loss + loc_loss
             loss.backward()
-            trainer.step(b)
+            # loss is already a batch MEAN: step(1) keeps rescale at 1
+            # (step(b) would divide the gradients by b a second time)
+            trainer.step(1)
             seen += b
             last = float(loss.asnumpy().ravel()[0])
             if first is None:

@@ -28,7 +28,7 @@ CATALOGUE = [
          "servers", False),
     Knob("MXNET_KVSTORE_DEBUG", int, 0, "kvstore_server.py",
          "verbose parameter-server tracing", False),
-    Knob("MXNET_SUBGRAPH_BACKEND", str, "", "subgraph.py",
+    Knob("MXNET_SUBGRAPH_BACKEND", str, "", "executor.py",
          "auto-partition bound graphs with this registered subgraph "
          "backend (reference build_subgraph pass)", False),
     Knob("MXNET_PS_SNAPSHOT_DIR", str, "", "kvstore_server.py",

@@ -40,9 +40,9 @@ class Executor:
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
                  grad_req="write", aux_states=None, group2ctx=None,
                  shared_exec=None):
-        import os as _os
+        from . import env as _env
 
-        backend = _os.environ.get("MXNET_SUBGRAPH_BACKEND")
+        backend = _env.get("MXNET_SUBGRAPH_BACKEND")
         if backend:
             # Auto-partition at bind like the reference's
             # MXNET_SUBGRAPH_BACKEND build_subgraph pass; unknown names

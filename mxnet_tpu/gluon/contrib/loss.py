@@ -13,7 +13,7 @@ matmul.
 """
 from __future__ import annotations
 
-from ..loss import Loss
+from ..loss import Loss, _apply_weighting
 
 __all__ = ["NCELoss"]
 
@@ -43,7 +43,8 @@ class NCELoss(Loss):
         self.num_sampled = num_sampled        # documented sampling width
         self.num_classes = num_classes        # noise distribution support
 
-    def hybrid_forward(self, F, embed, weight, bias, label, noise):
+    def hybrid_forward(self, F, embed, weight, bias, label, noise,
+                       sample_weight=None):
         # gathers via take: shape-free, so the symbolic export trace
         # works too
         lab = label.reshape((-1,))
@@ -58,5 +59,6 @@ class NCELoss(Loss):
         # -log sigmoid(s) = softplus(-s); -log(1-sigmoid(s)) = softplus(s)
         # (naive -log(sigmoid(s)+eps) has vanishing gradients exactly on
         # confidently-wrong examples)
-        return F.Activation(-s_true, act_type="softrelu") \
+        loss = F.Activation(-s_true, act_type="softrelu") \
             + F.Activation(s_noise, act_type="softrelu").sum(axis=1)
+        return _apply_weighting(F, loss, self._weight, sample_weight)

@@ -88,6 +88,19 @@ def _worker_initializer(dataset, is_child_process):
         import os
 
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # The env var alone is provably insufficient: the TPU PJRT
+        # plugin re-registers at import time and overrides it, so a
+        # worker that touches jax would still dial (and possibly hang
+        # on) the chip. Pin through the config API too — it wins as
+        # long as no backend has initialized in this child, which fork
+        # start methods guarantee only if the parent's client handle is
+        # unusable here anyway (the reason for this contract).
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
 
 def _worker_fn(samples, batchify_fn, dataset=None):

@@ -21,26 +21,34 @@ def _jnp():
     return jnp
 
 
+def _c(value, dtype):
+    """Constant-or-tracer cast: np.asarray would force concretization
+    of traced hyperparameters (TrainStep feeds lr as a runtime input so
+    LR schedules never retrace)."""
+    jnp = _jnp()
+    return jnp.asarray(value, dtype)
+
+
 def _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient):
     jnp = _jnp()
-    g = grad * np.asarray(rescale_grad, grad.dtype)
+    g = grad * _c(rescale_grad, grad.dtype)
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    return g + np.asarray(wd, weight.dtype) * weight
+    return g + _c(wd, weight.dtype) * weight
 
 
 @register("sgd_update", differentiable=False)
 def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, lazy_update=True):
     g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
-    return weight - np.asarray(lr, weight.dtype) * g
+    return weight - _c(lr, weight.dtype) * g
 
 
 @register("sgd_mom_update", differentiable=False)
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
-    new_mom = np.asarray(momentum, mom.dtype) * mom - np.asarray(lr, mom.dtype) * g
+    new_mom = _c(momentum, mom.dtype) * mom - _c(lr, mom.dtype) * g
     return weight + new_mom, new_mom
 
 
@@ -49,7 +57,7 @@ def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, lazy_update=True):
     g32 = _apply_wd_rescale(weight32, grad.astype(weight32.dtype), wd,
                             rescale_grad, clip_gradient)
-    new_w32 = weight32 - np.asarray(lr, weight32.dtype) * g32
+    new_w32 = weight32 - _c(lr, weight32.dtype) * g32
     return new_w32.astype(weight.dtype), new_w32
 
 
@@ -59,7 +67,7 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
                        lazy_update=True):
     g32 = _apply_wd_rescale(weight32, grad.astype(weight32.dtype), wd,
                             rescale_grad, clip_gradient)
-    new_mom = np.asarray(momentum, mom.dtype) * mom - np.asarray(lr, mom.dtype) * g32
+    new_mom = _c(momentum, mom.dtype) * mom - _c(lr, mom.dtype) * g32
     new_w32 = weight32 + new_mom
     return new_w32.astype(weight.dtype), new_mom, new_w32
 
@@ -68,8 +76,8 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
 def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
     g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
-    new_mom = np.asarray(momentum, mom.dtype) * mom + g
-    return weight - np.asarray(lr, weight.dtype) * (g + momentum * new_mom), new_mom
+    new_mom = _c(momentum, mom.dtype) * mom + g
+    return weight - _c(lr, weight.dtype) * (g + momentum * new_mom), new_mom
 
 
 @register("adam_update", differentiable=False)
@@ -80,7 +88,7 @@ def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * g * g
-    upd = np.asarray(lr, weight.dtype) * new_mean / (jnp.sqrt(new_var) + epsilon)
+    upd = _c(lr, weight.dtype) * new_mean / (jnp.sqrt(new_var) + epsilon)
     return weight - upd, new_mean, new_var
 
 
@@ -91,7 +99,7 @@ def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
     jnp = _jnp()
     g = _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient)
     new_n = gamma1 * n + (1 - gamma1) * g * g
-    new_w = weight - np.asarray(lr, weight.dtype) * g / jnp.sqrt(new_n + epsilon)
+    new_w = weight - _c(lr, weight.dtype) * g / jnp.sqrt(new_n + epsilon)
     if clip_weights is not None and clip_weights > 0:
         new_w = jnp.clip(new_w, -clip_weights, clip_weights)
     return new_w, new_n
@@ -116,7 +124,7 @@ def _rmspropalex_update(weight, grad, n, g_buf, delta, lr=0.001, gamma1=0.9,
 def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=-1.0):
     jnp = _jnp()
-    g = grad * np.asarray(rescale_grad, grad.dtype)
+    g = grad * _c(rescale_grad, grad.dtype)
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     new_n = n + g * g
@@ -134,7 +142,7 @@ def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
 def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
     jnp = _jnp()
-    g = grad * np.asarray(rescale_grad, grad.dtype) + wd * weight
+    g = grad * _c(rescale_grad, grad.dtype) + wd * weight
     if clip_grad is not None and clip_grad > 0:
         g = jnp.clip(g, -clip_grad, clip_grad)
     new_v = beta2 * v + (1 - beta2) * g * g
@@ -149,7 +157,7 @@ def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
 def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                     clip_gradient=-1.0):
     jnp = _jnp()
-    g = grad * np.asarray(rescale_grad, grad.dtype)
+    g = grad * _c(rescale_grad, grad.dtype)
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     return weight - lr * (jnp.sign(g) + wd * weight)
@@ -159,7 +167,7 @@ def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
 def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
     jnp = _jnp()
-    g = grad * np.asarray(rescale_grad, grad.dtype)
+    g = grad * _c(rescale_grad, grad.dtype)
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     new_mom = momentum * mom - (1 - momentum) * g

@@ -31,23 +31,10 @@ from .mesh import make_mesh, data_sharding, replicate, shard_params, \
 __all__ = ["TrainStep"]
 
 
-def _sgd_update(param, grad, state, lr, momentum, wd, rescale):
-    g = grad.astype(jnp.float32) * rescale + wd * param.astype(jnp.float32)
-    if momentum > 0:
-        mom = state * momentum - lr * g
-        return (param + mom.astype(param.dtype)), mom
-    return (param - (lr * g).astype(param.dtype)), state
-
-
-def _adam_update(param, grad, state, lr, t, beta1, beta2, epsilon, wd,
-                 rescale):
-    mean, var = state
-    g = grad.astype(jnp.float32) * rescale + wd * param.astype(jnp.float32)
-    mean = beta1 * mean + (1 - beta1) * g
-    var = beta2 * var + (1 - beta2) * g * g
-    lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
-    step = lr_t * mean / (jnp.sqrt(var) + epsilon)
-    return (param - step.astype(param.dtype)), (mean, var)
+def _as_pair(res):
+    """(new_weight, single_state) -> (new_weight, (single_state,))."""
+    w, s = res
+    return w, (s,)
 
 
 class TrainStep:
@@ -58,7 +45,9 @@ class TrainStep:
     net : initialized gluon Block (params live on one context; TrainStep
         takes ownership of the values and shards them over the mesh).
     loss_fn : callable (pred NDArray, label NDArray) -> per-sample loss.
-    optimizer : 'sgd' (momentum/wd) or 'adam'.
+    optimizer : sgd | nag | signum | signsgd | adam | rmsprop |
+        adagrad | adadelta | ftrl — the SAME update bodies as the
+        Trainer path (ops/optimizer_ops.py), fused into the step.
     optimizer_params : dict — learning_rate, momentum, wd, beta1/2, ...
         learning_rate is a *runtime input* to the executable, so LR
         schedules don't retrace.
@@ -80,6 +69,7 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else make_mesh()
         opt_params = dict(optimizer_params or {})
+        self._explicit = frozenset(opt_params)
         self.lr = float(opt_params.pop("learning_rate", 0.01))
         self.optimizer = optimizer
         self.momentum = float(opt_params.pop("momentum", 0.0))
@@ -88,13 +78,16 @@ class TrainStep:
         self.wd = float(opt_params.pop("wd", 0.0))
         self.beta1 = float(opt_params.pop("beta1", 0.9))
         self.beta2 = float(opt_params.pop("beta2", 0.999))
-        self.epsilon = float(opt_params.pop("epsilon", 1e-8))
+        self.epsilon = float(opt_params.pop("epsilon", 1e-8)) \
+            if "epsilon" in opt_params else None
         self.rescale_grad = float(opt_params.pop("rescale_grad", 1.0))
         clip = opt_params.pop("clip_gradient", None)
         self.clip_gradient = None if clip is None else float(clip)
-        if opt_params:
-            raise ValueError("TrainStep got unsupported optimizer_params %s"
-                             % sorted(opt_params))
+        # remaining knobs are optimizer-family specific (gamma1, rho,
+        # lamda1, ...), resolved by _make_opt_rule with the same
+        # defaults as mxnet_tpu.optimizer's classes
+        self._opt_extra = opt_params
+        self._opt_n_states, self._opt_update = self._make_opt_rule()
         self.num_update = 0
 
         self._dtype = dtype
@@ -102,10 +95,139 @@ class TrainStep:
         self._jitted = None
         self._materialized = False
 
+    def _make_opt_rule(self):
+        """(n_states, update_fn) for the configured optimizer.
+
+        update_fn(param, grad, states_tuple, lr, t) ->
+        (new_param, new_states_tuple). The bodies are the SAME pure
+        FCompute functions the imperative Trainer path dispatches
+        (ops/optimizer_ops.py), so TrainStep and Trainer produce
+        bit-identical updates for every supported family."""
+        from ..ops import optimizer_ops as oo
+
+        name = self.optimizer.lower()
+        mom, wd, rs = self.momentum, self.wd, self.rescale_grad
+        clip = -1.0 if self.clip_gradient is None else self.clip_gradient
+        b1, b2 = self.beta1, self.beta2
+        ex = self._opt_extra
+
+        def eps(default):
+            return self.epsilon if self.epsilon is not None else default
+
+        def check_extra(*allowed):
+            unknown = set(ex) - set(allowed)
+            if unknown:
+                raise ValueError(
+                    "TrainStep(%s) got unsupported optimizer_params %s"
+                    % (name, sorted(unknown)))
+
+        if name == "sgd":
+            check_extra()
+            if mom > 0:
+                return 1, lambda p, g, s, lr, t: _as_pair(
+                    oo._sgd_mom_update(p, g, s[0], lr=lr, momentum=mom,
+                                       wd=wd, rescale_grad=rs,
+                                       clip_gradient=clip))
+            return 0, lambda p, g, s, lr, t: (
+                oo._sgd_update(p, g, lr=lr, wd=wd, rescale_grad=rs,
+                               clip_gradient=clip), ())
+        if name == "nag":
+            check_extra()
+            if mom > 0:
+                return 1, lambda p, g, s, lr, t: _as_pair(
+                    oo._nag_mom_update(p, g, s[0], lr=lr, momentum=mom,
+                                       wd=wd, rescale_grad=rs,
+                                       clip_gradient=clip))
+            return 0, lambda p, g, s, lr, t: (
+                oo._sgd_update(p, g, lr=lr, wd=wd, rescale_grad=rs,
+                               clip_gradient=clip), ())
+        if name in ("signum", "signsgd"):
+            check_extra("wd_lh")
+            # Trainer's Signum defaults to momentum=0.9 (optimizer.py);
+            # mirror it unless the caller set momentum explicitly.
+            if name == "signum":
+                sig_mom = mom if "momentum" in self._explicit else 0.9
+            else:
+                sig_mom = 0.0
+            wd_lh = float(ex.get("wd_lh", 0.0))
+            if sig_mom > 0:
+                return 1, lambda p, g, s, lr, t: _as_pair(
+                    oo._signum_update(p, g, s[0], lr=lr, momentum=sig_mom,
+                                      wd=wd, rescale_grad=rs,
+                                      clip_gradient=clip, wd_lh=wd_lh))
+            return 0, lambda p, g, s, lr, t: (
+                oo._signsgd_update(p, g, lr=lr, wd=wd, rescale_grad=rs,
+                                   clip_gradient=clip), ())
+        if name == "adam":
+            check_extra()
+            e = eps(1e-8)
+
+            def adam(p, g, s, lr, t):
+                lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+                w, m, v = oo._adam_update(
+                    p, g, s[0], s[1], lr=lr_t, beta1=b1, beta2=b2,
+                    epsilon=e, wd=wd, rescale_grad=rs, clip_gradient=clip)
+                return w, (m, v)
+
+            return 2, adam
+        if name == "rmsprop":
+            check_extra("gamma1", "gamma2", "centered", "clip_weights")
+            g1 = float(ex.get("gamma1", 0.9))
+            g2 = float(ex.get("gamma2", 0.9))
+            cw = float(ex.get("clip_weights", -1.0))
+            e = eps(1e-8)
+            if ex.get("centered", False):
+                def rmsc(p, g, s, lr, t):
+                    w, n, gb, d = oo._rmspropalex_update(
+                        p, g, s[0], s[1], s[2], lr=lr, gamma1=g1,
+                        gamma2=g2, epsilon=e, wd=wd, rescale_grad=rs,
+                        clip_gradient=clip, clip_weights=cw)
+                    return w, (n, gb, d)
+
+                return 3, rmsc
+            return 1, lambda p, g, s, lr, t: _as_pair(
+                oo._rmsprop_update(p, g, s[0], lr=lr, gamma1=g1,
+                                   epsilon=e, wd=wd, rescale_grad=rs,
+                                   clip_gradient=clip, clip_weights=cw))
+        if name == "adagrad":
+            check_extra("eps")
+            e = float(ex.get("eps", 1e-7))
+            return 1, lambda p, g, s, lr, t: _as_pair(
+                oo._adagrad_update(p, g, s[0], lr=lr, epsilon=e, wd=wd,
+                                   rescale_grad=rs, clip_gradient=clip))
+        if name == "adadelta":
+            check_extra("rho")
+            rho = float(ex.get("rho", 0.90))
+            e = eps(1e-5)
+
+            def adad(p, g, s, lr, t):
+                w, ag, ad = oo._adadelta_update(
+                    p, g, s[0], s[1], rho=rho, epsilon=e, wd=wd,
+                    rescale_grad=rs, clip_gradient=clip)
+                return w, (ag, ad)
+
+            return 2, adad
+        if name == "ftrl":
+            check_extra("lamda1", "beta")
+            lam = float(ex.get("lamda1", 0.01))
+            beta = float(ex.get("beta", 1.0))
+
+            def ftrl(p, g, s, lr, t):
+                w, z, n = oo._ftrl_update(
+                    p, g, s[0], s[1], lr=lr, lamda1=lam, beta=beta,
+                    wd=wd, rescale_grad=rs, clip_gradient=clip)
+                return w, (z, n)
+
+            return 2, ftrl
+        raise ValueError(
+            "TrainStep supports sgd/nag/signum/signsgd/adam/rmsprop/"
+            "adagrad/adadelta/ftrl (got %r); for other optimizers use "
+            "gluon.Trainer" % self.optimizer)
+
     def _materialize(self, x_example):
         """Collect param values (triggering deferred init with a real
         forward if needed) and lay them out on the mesh."""
-        net, optimizer = self.net, self.optimizer
+        net = self.net
         params = list(net.collect_params().values())
         if any(p._data is None and p._deferred_init is not None
                for p in params):
@@ -122,17 +244,14 @@ class TrainStep:
         self._aux_vals = {p.name: p.data()._data for p in self._aux_params}
 
         # Optimizer state mirrors param sharding (ZeRO-0; the state is
-        # sharded exactly like its weight so updates are local).
-        if optimizer == "sgd":
-            self._opt_state = {n: jnp.zeros_like(v, dtype=jnp.float32)
-                               for n, v in self._param_vals.items()}
-        elif optimizer == "adam":
-            self._opt_state = {n: (jnp.zeros_like(v, dtype=jnp.float32),
-                                   jnp.zeros_like(v, dtype=jnp.float32))
-                               for n, v in self._param_vals.items()}
-        else:
-            raise ValueError("TrainStep supports 'sgd' and 'adam'; for other "
-                             "optimizers use gluon.Trainer")
+        # sharded exactly like its weight so updates are local). Always
+        # a k-tuple per param (k from the optimizer rule; empty for
+        # stateless rules).
+        k = self._opt_n_states
+        self._opt_state = {
+            n: tuple(jnp.zeros_like(v, dtype=jnp.float32)
+                     for _ in range(k))
+            for n, v in self._param_vals.items()}
 
         self._shardings = shard_params(
             self.mesh, {n: v.shape for n, v in self._param_vals.items()},
@@ -145,13 +264,9 @@ class TrainStep:
                             for n, v in self._param_vals.items()}
         self._aux_vals = {n: jax.device_put(v, self._repl)
                           for n, v in self._aux_vals.items()}
-        if optimizer == "adam":
-            self._opt_state = {
-                n: tuple(jax.device_put(s, self._shardings[n]) for s in st)
-                for n, st in self._opt_state.items()}
-        else:
-            self._opt_state = {n: jax.device_put(v, self._shardings[n])
-                               for n, v in self._opt_state.items()}
+        self._opt_state = {
+            n: tuple(jax.device_put(s, self._shardings[n]) for s in st)
+            for n, st in self._opt_state.items()}
         self._materialized = True
 
     # -- the pure step --------------------------------------------------------
@@ -160,10 +275,6 @@ class TrainStep:
         net, loss_fn = self.net, self.loss_fn
         train_params = self._train_params
         aux_params = self._aux_params
-        optimizer = self.optimizer
-        momentum, wd = self.momentum, self.wd
-        beta1, beta2, epsilon = self.beta1, self.beta2, self.epsilon
-        rescale = self.rescale_grad
 
         cdt = None if self._dtype is None else jnp.dtype(self._dtype)
 
@@ -198,30 +309,21 @@ class TrainStep:
                 new_aux[p.name] = nv.astype(aux_vals[p.name].dtype)
             return jnp.mean(loss._data), new_aux
 
-        clip = self.clip_gradient
+        opt_update = self._opt_update
 
         def step(pvals, opt_state, aux_vals, x, y, lr, t, key):
             (loss, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(pvals, aux_vals, x, y, key)
             new_p, new_s = {}, {}
             for name, p in pvals.items():
-                g = grads[name]
-                if clip is not None:
-                    # Elementwise clip after rescale, matching
-                    # Optimizer.clip_gradient semantics (optimizer.py).
-                    g = jnp.clip(g * rescale, -clip, clip) / rescale
-                if optimizer == "sgd":
-                    new_p[name], new_s[name] = _sgd_update(
-                        p, g, opt_state[name], lr, momentum, wd, rescale)
-                else:
-                    new_p[name], new_s[name] = _adam_update(
-                        p, g, opt_state[name], lr, t, beta1, beta2, epsilon,
-                        wd, rescale)
+                g = grads[name].astype(jnp.float32)
+                new_p[name], new_s[name] = opt_update(
+                    p, g, opt_state[name], lr, t)
             return new_p, new_s, new_aux, loss
 
         shardings = self._shardings
-        state_shardings = {n: (shardings[n] if optimizer == "sgd"
-                               else (shardings[n], shardings[n]))
+        k = self._opt_n_states
+        state_shardings = {n: tuple(shardings[n] for _ in range(k))
                            for n in shardings}
         aux_shardings = {p.name: self._repl for p in aux_params}
         in_shardings = (shardings, state_shardings, aux_shardings,
