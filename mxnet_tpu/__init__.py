@@ -67,6 +67,9 @@ _LAZY = {
     "registry": ".registry_util",
     "attribute": ".attribute",
     "name": ".name",
+    "log": ".log",
+    "libinfo": ".libinfo",
+    "subgraph": ".subgraph",
 }
 
 

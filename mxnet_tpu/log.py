@@ -1,0 +1,68 @@
+"""Colored logging helpers (reference: python/mxnet/log.py).
+
+`get_logger(name, filename, filemode, level)` returns a logger with the
+reference's level-labelled formatter; terminal streams get ANSI colors.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING",
+           "ERROR", "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+_COLORS = {logging.WARNING: "\x1b[0;33m", logging.ERROR: "\x1b[0;31m",
+           logging.INFO: "\x1b[0;32m", logging.DEBUG: "\x1b[0;34m"}
+_LABELS = {logging.WARNING: "W", logging.ERROR: "E", logging.INFO: "I",
+           logging.DEBUG: "D"}
+
+
+class _Formatter(logging.Formatter):
+    """Level-labelled (optionally colored) record format
+    (reference log.py:37)."""
+
+    def __init__(self, colored=True):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        label = _LABELS.get(record.levelno, "U")
+        if self._colored and record.levelno in _COLORS:
+            label = _COLORS[record.levelno] + label + "\x1b[0m"
+        self._style._fmt = label + "%(asctime)s %(process)d %(pathname)s" \
+            ":%(lineno)d] %(message)s"
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Logger with the reference formatter (reference log.py:90)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_init_done", False):
+        logger.setLevel(level)
+        return logger
+    logger._init_done = True
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        colored = False
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        colored = getattr(sys.stderr, "isatty", lambda: False)()
+    handler.setFormatter(_Formatter(colored))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    return logger
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated alias (reference log.py:80)."""
+    import warnings
+
+    warnings.warn("getLogger is deprecated, use get_logger instead",
+                  DeprecationWarning)
+    return get_logger(name, filename, filemode, level)

@@ -143,12 +143,13 @@ class TrainStep:
                                clip_gradient=clip), ())
         if name in ("signum", "signsgd"):
             check_extra("wd_lh")
-            # Trainer's Signum defaults to momentum=0.9 (optimizer.py);
-            # mirror it unless the caller set momentum explicitly.
-            if name == "signum":
-                sig_mom = mom if "momentum" in self._explicit else 0.9
+            # Trainer defaults: Signum momentum=0.9, SignSGD 0.0 — but
+            # an explicitly passed momentum wins for BOTH (SignSGD only
+            # setdefault's it, optimizer.py:261).
+            if "momentum" in self._explicit:
+                sig_mom = mom
             else:
-                sig_mom = 0.0
+                sig_mom = 0.9 if name == "signum" else 0.0
             wd_lh = float(ex.get("wd_lh", 0.0))
             if sig_mom > 0:
                 return 1, lambda p, g, s, lr, t: _as_pair(
@@ -191,7 +192,9 @@ class TrainStep:
                                    clip_gradient=clip, clip_weights=cw))
         if name == "adagrad":
             check_extra("eps")
-            e = float(ex.get("eps", 1e-7))
+            # AdaGrad spells its knob "eps" (optimizer.py:322) but an
+            # "epsilon" kwarg must not be silently discarded either
+            e = float(ex.get("eps", eps(1e-7)))
             return 1, lambda p, g, s, lr, t: _as_pair(
                 oo._adagrad_update(p, g, s[0], lr=lr, epsilon=e, wd=wd,
                                    rescale_grad=rs, clip_gradient=clip))

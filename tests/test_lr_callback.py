@@ -1,0 +1,121 @@
+"""LR schedulers and training callbacks (reference:
+python/mxnet/lr_scheduler.py, callback.py + their unittests in
+tests/python/unittest/test_lr_scheduler.py)."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _ref_factor(num_updates, step, factor, base, stop):
+    """Literal replay of the reference's stateful loop."""
+    lr, count, out = base, 0, []
+    for n in num_updates:
+        while n > count + step:
+            count += step
+            lr = max(lr * factor, stop)
+        out.append(lr)
+    return out
+
+
+def test_factor_scheduler_matches_reference_loop():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                            base_lr=1.0,
+                                            stop_factor_lr=0.02)
+    updates = [1, 5, 10, 11, 20, 21, 35, 80, 200]
+    got = [sched(u) for u in updates]
+    want = _ref_factor(updates, 10, 0.5, 1.0, 0.02)
+    np.testing.assert_allclose(got, want)
+    assert got[-1] == 0.02            # floored at stop_factor_lr
+
+
+def test_multi_factor_scheduler_boundaries():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 9], factor=0.1,
+                                                 base_lr=1.0)
+    # decay fires strictly AFTER each boundary
+    assert sched(5) == 1.0
+    assert abs(sched(6) - 0.1) < 1e-12
+    assert abs(sched(9) - 0.1) < 1e-12
+    assert abs(sched(10) - 0.01) < 1e-12
+    assert abs(sched(100) - 0.01) < 1e-12
+
+
+def test_poly_and_cosine_schedulers():
+    poly = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0,
+                                         pwr=2, final_lr=0.1)
+    assert abs(poly(0) - 1.0) < 1e-12
+    assert abs(poly(50) - (0.1 + 0.9 * 0.25)) < 1e-12
+    assert abs(poly(100) - 0.1) < 1e-12
+    assert abs(poly(500) - 0.1) < 1e-12   # holds final value
+
+    cos = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0,
+                                          final_lr=0.0)
+    assert abs(cos(0) - 1.0) < 1e-12
+    assert abs(cos(50) - 0.5) < 1e-12
+    assert abs(cos(100) - 0.0) < 1e-12
+    assert abs(cos(400) - 0.0) < 1e-12
+
+
+def test_scheduler_warmup():
+    sched = mx.lr_scheduler.FactorScheduler(step=100, factor=0.9,
+                                            base_lr=1.0, warmup_steps=10,
+                                            warmup_begin_lr=0.0)
+    assert sched(0) == 0.0
+    assert abs(sched(5) - 0.5) < 1e-12
+    assert sched(10) == 1.0
+    with pytest.raises(ValueError):
+        mx.lr_scheduler.FactorScheduler(step=5, warmup_mode="bogus")
+
+
+def test_scheduler_drives_optimizer():
+    """lr_scheduler plugs into the optimizer the reference way."""
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[2], factor=0.1)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.array([0.0])
+    g = mx.nd.array([1.0])
+    st = opt.create_state(0, w)
+    deltas = []
+    for _ in range(4):
+        before = float(w.asnumpy()[0])
+        opt.update(0, w, g, st)
+        deltas.append(before - float(w.asnumpy()[0]))
+    # steps 1,2 at lr=1.0; steps 3,4 at lr=0.1
+    np.testing.assert_allclose(deltas, [1.0, 1.0, 0.1, 0.1], rtol=1e-6)
+
+
+class _Param:
+    def __init__(self, epoch, nbatch, metric):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = metric
+
+
+def test_speedometer_reports_on_frequency(caplog):
+    meter = mx.callback.Speedometer(batch_size=4, frequent=2,
+                                    auto_reset=True)
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([0.0])],
+                  [mx.nd.array([[0.9, 0.1]]).argmax(axis=1) * 0])
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(1, 7):
+            meter(_Param(0, nbatch, metric))
+    msgs = [r.message for r in caplog.records if "samples/sec" in r.message]
+    # batch 1 opens the window; reports fire at batches 2, 4, 6
+    assert len(msgs) == 3
+    assert all("Epoch[0]" in m and "accuracy" in m for m in msgs)
+
+
+def test_speedometer_resets_across_epochs(caplog):
+    meter = mx.callback.Speedometer(batch_size=4, frequent=2,
+                                    auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(1, 5):
+            meter(_Param(0, nbatch, None))
+        for nbatch in range(1, 5):   # new epoch: counter restarts
+            meter(_Param(1, nbatch, None))
+    msgs = [r.message for r in caplog.records if "samples/sec" in r.message]
+    assert len(msgs) == 4
+    assert all("Iter[0]" in m for m in msgs[:2])
+    assert all("Iter[1]" in m for m in msgs[2:])

@@ -52,3 +52,20 @@ def test_env_catalogue():
         assert env.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 42
     finally:
         del os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"]
+
+
+def test_log_and_libinfo():
+    """Reference parity shims: mx.log.get_logger and mx.libinfo
+    (python/mxnet/log.py, libinfo.py)."""
+    import logging
+
+    logger = mx.log.get_logger("mxtest", level=mx.log.INFO)
+    assert logger.level == logging.INFO
+    # idempotent: second call must not stack handlers
+    again = mx.log.get_logger("mxtest", level=mx.log.DEBUG)
+    assert again is logger and len(logger.handlers) == 1
+
+    feats = mx.libinfo.features()
+    assert feats["XLA"] and feats["SPMD"] and not feats["CUDA"]
+    assert feats["DIST_KVSTORE"] and feats["BF16"]
+    assert isinstance(mx.libinfo.find_lib_path(), list)
