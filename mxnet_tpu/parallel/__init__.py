@@ -20,6 +20,9 @@ TPU-native equivalents here subsume all three:
 """
 from .mesh import make_mesh, data_sharding, replicate, shard_params
 from .train_step import TrainStep
+from .ring_attention import (ring_attention, ring_self_attention,
+                             blockwise_attention)
 
 __all__ = ["make_mesh", "data_sharding", "replicate", "shard_params",
-           "TrainStep"]
+           "TrainStep", "ring_attention", "ring_self_attention",
+           "blockwise_attention"]
