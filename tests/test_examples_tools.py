@@ -110,3 +110,16 @@ def test_train_ssd_synthetic():
     line = [l for l in out.splitlines() if l.startswith("final-loss")]
     assert line, out
     assert float(line[0].split()[3]) > 0.5, "recall too low: %s" % line
+
+
+def test_gluon_image_classification_hybrid():
+    """The Gluon imperative/hybrid driver (reference
+    example/gluon/image_classification.py) trains to high accuracy in
+    hybrid (compiled) mode."""
+    out = _run([sys.executable, "examples/gluon_image_classification.py",
+                "--model", "resnet18_v1", "--num-examples", "384",
+                "--epochs", "8", "--batch-size", "32", "--lr", "0.1"],
+               timeout=540)
+    line = [l for l in out.splitlines() if l.startswith("final-accuracy")]
+    assert line, out
+    assert float(line[0].split()[1]) > 0.7
