@@ -33,6 +33,10 @@ def synthetic_cifar(n, num_classes, rng, size=32):
     X = (rng.rand(n, 3, size, size) * 0.3).astype(np.float32)
     y = rng.randint(0, num_classes, n)
     band = size // num_classes
+    if band < 1:
+        raise ValueError(
+            "num_classes=%d exceeds image size %d: the class-identifying "
+            "band would be empty (unlearnable noise)" % (num_classes, size))
     for i in range(n):
         c = y[i]
         X[i, c % 3, c * band:(c + 1) * band, :] += 1.0

@@ -123,3 +123,44 @@ def test_gluon_image_classification_hybrid():
     line = [l for l in out.splitlines() if l.startswith("final-accuracy")]
     assert line, out
     assert float(line[0].split()[1]) > 0.7
+
+
+def test_rec2idx_roundtrip(tmp_path):
+    """rec2idx rebuilds a usable index for an unindexed .rec
+    (reference tools/rec2idx.py)."""
+    import mxnet_tpu as mx
+
+    rec_path = str(tmp_path / "data.rec")
+    rec = mx.recordio.MXRecordIO(rec_path, "w")
+    payloads = [("item%03d" % i).encode() * (i + 1) for i in range(7)]
+    for p in payloads:
+        rec.write(p)
+    rec.close()
+
+    _run([sys.executable, "tools/rec2idx.py", rec_path])
+    idx_path = str(tmp_path / "data.idx")
+    assert os.path.exists(idx_path)
+    reader = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert sorted(reader.keys) == list(range(7))
+    for i in (3, 0, 6):        # random access
+        assert reader.read_idx(i) == payloads[i]
+    reader.close()
+
+
+def test_parse_log():
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    from parse_log import parse, render
+
+    lines = [
+        "INFO Epoch[0] Train-accuracy=0.5\n",
+        "INFO Epoch[0] Validation-accuracy=0.4\n",
+        "INFO Epoch[0] Time cost=12.5\n",
+        "INFO Epoch[1] Train-accuracy=0.8\n",
+        "INFO Epoch[1] Validation-accuracy=0.7\n",
+        "INFO Epoch[1] Time cost=11.0\n",
+    ]
+    data = parse(lines, ["accuracy"])
+    out = render(data, ["accuracy"], "markdown")
+    assert "| epoch |" in out and "0.800000" in out and "11.0" in out
+    tsv = render(data, ["accuracy"], "none")
+    assert tsv.splitlines()[0].startswith("epoch\t")

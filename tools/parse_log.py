@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown/TSV table.
+
+Reference: tools/parse_log.py — extracts per-epoch Train-/Validation-
+metric values and epoch times from `Module.fit`-style log output.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+
+
+def parse(lines, metric_names):
+    patterns = (
+        [re.compile(r".*Epoch\[(\d+)\] Train-%s.*=([.\d]+)" % m)
+         for m in metric_names]
+        + [re.compile(r".*Epoch\[(\d+)\] Validation-%s.*=([.\d]+)" % m)
+           for m in metric_names]
+        + [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")])
+    data = {}
+    for line in lines:
+        for i, pat in enumerate(patterns):
+            m = pat.match(line)
+            if m is None:
+                continue
+            epoch = int(m.group(1))
+            row = data.setdefault(epoch, [0.0] * (len(patterns) * 2))
+            row[2 * i] += float(m.group(2))
+            row[2 * i + 1] += 1
+            break
+    return data
+
+
+def render(data, metric_names, fmt):
+    cols = (["train-" + m for m in metric_names]
+            + ["val-" + m for m in metric_names] + ["time"])
+
+    def cells(row):
+        out = []
+        for j in range(len(cols)):
+            total, count = row[2 * j], row[2 * j + 1]
+            out.append("%f" % (total / count) if count else "-")
+        return out
+
+    lines = []
+    if fmt == "markdown":
+        lines.append("| epoch | " + " | ".join(cols) + " |")
+        lines.append("| --- " * (len(cols) + 1) + "|")
+        for epoch in sorted(data):
+            lines.append("| %2d | %s |"
+                         % (epoch + 1, " | ".join(cells(data[epoch]))))
+    else:
+        lines.append("\t".join(["epoch"] + cols))
+        for epoch in sorted(data):
+            lines.append("\t".join(["%2d" % (epoch + 1)]
+                                   + cells(data[epoch])))
+    return "\n".join(lines)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Parse training output log")
+    parser.add_argument("logfile", nargs=1)
+    parser.add_argument("--format", default="markdown",
+                        choices=["markdown", "none"])
+    parser.add_argument("--metric-names", nargs="+",
+                        default=["accuracy"])
+    args = parser.parse_args()
+    with open(args.logfile[0]) as f:
+        data = parse(f.readlines(), args.metric_names)
+    print(render(data, args.metric_names, args.format))
+
+
+if __name__ == "__main__":
+    main()

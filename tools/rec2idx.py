@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Create a random-access .idx for an existing RecordIO .rec file.
+
+Reference: tools/rec2idx.py (IndexCreator over MXRecordIO) — needed when
+a .rec was packed without its index (shuffling/partitioning in
+ImageRecordIter requires one).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio
+
+
+class IndexCreator(recordio.MXRecordIO):
+    """Reads a .rec sequentially, writing `key\\tposition` lines
+    (reference rec2idx.py:IndexCreator)."""
+
+    def __init__(self, uri, idx_path, key_type=int):
+        self.key_type = key_type
+        self.fidx = None
+        self.idx_path = idx_path
+        super().__init__(uri, "r")
+
+    def open(self):
+        super().open()
+        self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+
+    def create_index(self):
+        """Walk the record stream, emitting one index row per record."""
+        self.reset()
+        counter = 0
+        t0 = time.time()
+        while True:
+            pos = self.tell()
+            if self.read() is None:
+                break
+            self.fidx.write("%s\t%d\n" % (self.key_type(counter), pos))
+            counter += 1
+            if counter % 1000 == 0:
+                print("%d records indexed (%.1fs)"
+                      % (counter, time.time() - t0))
+        return counter
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an index file for a RecordIO file",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("record", help="path to the .rec file")
+    parser.add_argument("index", nargs="?", default=None,
+                        help="output .idx path (default: .rec -> .idx)")
+    args = parser.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    creator = IndexCreator(args.record, idx)
+    n = creator.create_index()
+    creator.close()
+    print("wrote %s (%d records)" % (idx, n))
+
+
+if __name__ == "__main__":
+    main()
