@@ -21,5 +21,6 @@ from . import control_flow  # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import rcnn_ops  # noqa: F401
+from . import pallas_attention  # noqa: F401
 
 __all__ = ["registry", "register", "get", "list_all_ops", "OP_REGISTRY"]

@@ -142,21 +142,22 @@ def blockwise_attention(q, k, v, block=128, causal=False, scale=None):
     """Memory-tiled exact attention on one device: the same online-
     softmax accumulation scanned over K/V blocks. Handles sequences
     whose full score matrix would not fit in HBM."""
-    b, h, t, d = q.shape
+    b, h, tq, d = q.shape
+    tk = k.shape[2]                     # cross-attention: tk may != tq
     scale = scale if scale is not None else d ** -0.5
-    block = min(block, t)
-    if t % block:
+    block = min(block, tk)
+    if tk % block:
         raise ValueError("sequence length %d not divisible by block %d"
-                         % (t, block))
-    nb = t // block
+                         % (tk, block))
+    nb = tk // block
     kb = k.astype(jnp.float32).reshape(b, h, nb, block, d)
     vb = v.astype(jnp.float32).reshape(b, h, nb, block, d)
     q32 = q.astype(jnp.float32)
 
-    m0 = jnp.full((b, h, t), _NEG, jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, tq), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
     acc0 = jnp.zeros(q32.shape, jnp.float32)
-    q_pos = jnp.arange(t)
+    q_pos = jnp.arange(tq)
 
     def body(carry, inputs):
         m, l, acc = carry
@@ -165,7 +166,7 @@ def blockwise_attention(q, k, v, block=128, causal=False, scale=None):
         if causal:
             k_pos = j * block + jnp.arange(block)
             mask = jnp.broadcast_to(q_pos[:, None] >= k_pos[None, :],
-                                    (b, h, t, block))
+                                    (b, h, tq, block))
         m, l, acc = _accumulate_block(q32, kj, vj, scale, m, l, acc, mask)
         return (m, l, acc), None
 

@@ -147,8 +147,8 @@ def test_rec2idx_roundtrip(tmp_path):
     reader.close()
 
 
-def test_parse_log():
-    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+def test_parse_log(monkeypatch):
+    monkeypatch.syspath_prepend(os.path.join(_ROOT, "tools"))
     from parse_log import parse, render
 
     lines = [
