@@ -20,8 +20,7 @@ __all__ = [
     "default_context", "set_default_context", "assert_almost_equal",
     "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
     "rand_shape_nd", "check_numeric_gradient", "check_consistency",
-    "same", "retry",
-]
+    "same", "retry", "check_speed"]
 
 _default_ctx = None
 
@@ -172,3 +171,45 @@ def retry(n=3):
         return wrapped
 
     return deco
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
+                typ="whole", **kwargs):
+    """Average seconds per forward(+backward) of a bound symbol
+    (reference test_utils.py:check_speed). ``kwargs`` are input shapes
+    for simple_bind when `location` is not given."""
+    import time
+
+    if grad_req is None:
+        grad_req = "write"
+    if location is None:
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx, **kwargs)
+        location = {k: np.random.normal(size=arr.shape, scale=1.0)
+                    for k, arr in exe.arg_dict.items()}
+    else:
+        assert isinstance(location, dict), \
+            'Expect dict, get "location"=%s' % str(location)
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx,
+                              **{k: v.shape for k, v in location.items()})
+    for name, iarr in location.items():
+        exe.arg_dict[name][:] = iarr     # __setitem__ casts to dtype
+
+    if typ == "whole":
+        exe.forward(is_train=True)
+        exe.backward(out_grads=exe.outputs)
+        nd.waitall()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=True)
+            exe.backward(out_grads=exe.outputs)
+        nd.waitall()
+        return (time.time() - tic) / N
+    if typ == "forward":
+        exe.forward(is_train=False)
+        nd.waitall()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=False)
+        nd.waitall()
+        return (time.time() - tic) / N
+    raise ValueError("typ can only be 'whole' or 'forward', got %r" % typ)

@@ -100,3 +100,18 @@ def test_layernorm_scale_center_off():
     ln.initialize()
     assert ln.gamma.grad_req == "null"
     assert ln.beta.grad_req == "null"
+
+
+def test_check_speed_utility():
+    """test_utils.check_speed parity (reference test_utils.py:1131)."""
+    from mxnet_tpu.test_utils import check_speed
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    t_whole = check_speed(net, N=3, data=(4, 4))
+    t_fwd = check_speed(net, N=3, typ="forward", data=(4, 4))
+    assert t_whole > 0 and t_fwd > 0
+    import pytest
+
+    with pytest.raises(ValueError, match="typ"):
+        check_speed(net, N=1, typ="bogus", data=(4, 4))
