@@ -127,7 +127,10 @@ def test_hybridized_cell_unroll():
     out_e, st_e = cell.unroll(5, x, layout="NTC", merge_outputs=True)
     cell.hybridize()
     out_h, st_h = cell.unroll(5, x, layout="NTC", merge_outputs=True)
-    np.testing.assert_allclose(out_e.asnumpy(), out_h.asnumpy(), rtol=1e-5)
+    # atol: eager-vs-compiled fusion reordering can drift near-zero
+    # elements past any pure-rtol bound (seed-dependent flake)
+    np.testing.assert_allclose(out_e.asnumpy(), out_h.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_legacy_symbolic_cells():
