@@ -131,6 +131,9 @@ def test_hybridized_cell_unroll():
     # elements past any pure-rtol bound (seed-dependent flake)
     np.testing.assert_allclose(out_e.asnumpy(), out_h.asnumpy(),
                                rtol=1e-5, atol=1e-6)
+    for a, b in zip(st_e, st_h):   # final (h, c) states must match too
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
 
 
 def test_legacy_symbolic_cells():
