@@ -9,9 +9,11 @@ RecordIO framing. The on-disk format is reimplemented natively here
     lrec   := cflag(3 bits) << 29 | length(29 bits)
 
 cflag handles records spanning chunks: 0 = whole record, 1 = begin,
-2 = middle, 3 = end. A C++ chunked reader (src/ in this repo) provides
-the high-throughput path for the data pipeline; this module is the
-authoritative pure-python implementation and the fallback.
+2 = middle, 3 = end. The C++ chunked scanner/reader
+(src/recordio_core.cc, loaded via `mxnet_tpu.recordio_native`) provides
+the high-throughput path (whole-file index scans, random-access reads
+with no per-frame Python overhead); this module is the authoritative
+pure-python implementation and the fallback.
 """
 from __future__ import annotations
 

@@ -1,0 +1,109 @@
+"""ctypes loader for the native RecordIO core (src/recordio_core.cc).
+
+The C++ scanner/reader is the data pipeline's high-throughput path: a
+whole-file index scan and random-access record reads with no Python
+per-frame overhead. Built on demand with g++ (cached as a .so next to
+the source); every entry point degrades to the pure-python
+implementation in `mxnet_tpu.recordio` when the toolchain or the build
+is unavailable — the wire format is identical.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["available", "native_index", "native_read_at"]
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src", "recordio_core.cc")
+_SO = os.path.splitext(_SRC)[0] + ".so"
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_ERRORS = {-1: "cannot open file", -2: "invalid RecordIO magic",
+           -3: "truncated record", -4: "capacity exceeded"}
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                # build to a private temp path, then atomically rename:
+                # concurrent processes (DataLoader workers, parallel
+                # pytest) must never dlopen a half-written .so — the
+                # per-process lock cannot serialize across processes
+                tmp = "%s.build.%d" % (_SO, os.getpid())
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     _SRC, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _SO)
+            lib = ctypes.CDLL(_SO)
+            # binding stays inside the try: a stale .so missing a
+            # symbol must degrade to the python fallback, not raise
+            lib.rio_index.restype = ctypes.c_longlong
+            lib.rio_index.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_ulonglong),
+                ctypes.c_ulonglong]
+            lib.rio_read_at.restype = ctypes.c_int
+            lib.rio_read_at.argtypes = [
+                ctypes.c_char_p, ctypes.c_ulonglong,
+                ctypes.POINTER(ctypes.c_ubyte), ctypes.c_ulonglong,
+                ctypes.POINTER(ctypes.c_ulonglong)]
+        except (OSError, subprocess.SubprocessError,
+                FileNotFoundError, AttributeError):
+            return None
+        _lib = lib
+        return _lib
+
+
+def available():
+    """True when the native core is built and loadable."""
+    return _load() is not None
+
+
+def _check(rc, path):
+    if rc < 0:
+        raise IOError("%s: %s" % (_ERRORS.get(rc, "error %d" % rc), path))
+
+
+def native_index(path):
+    """Offsets of every logical record in a .rec file (native scan).
+    Returns a list of byte offsets; raises IOError on corrupt files."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native recordio core unavailable")
+    path_b = os.fspath(path).encode()
+    # one pass: every frame costs >= 8 header bytes, so size//8 bounds
+    # the record count (a count-then-fill double scan would read the
+    # file twice and race concurrent appenders)
+    cap = max(1, os.path.getsize(path) // 8)
+    arr = (ctypes.c_ulonglong * cap)()
+    n = lib.rio_index(path_b, arr, cap)
+    _check(n, path)
+    return list(arr[:n])
+
+
+def native_read_at(path, offset):
+    """One logical record (continuation chunks reassembled) starting at
+    `offset`, as bytes."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native recordio core unavailable")
+    path_b = os.fspath(path).encode()
+    length = ctypes.c_ulonglong()
+    rc = lib.rio_read_at(path_b, offset, None, 0, ctypes.byref(length))
+    _check(rc, path)
+    buf = (ctypes.c_ubyte * length.value)()
+    rc = lib.rio_read_at(path_b, offset, buf, length.value,
+                         ctypes.byref(length))
+    _check(rc, path)
+    return bytes(buf[:length.value])

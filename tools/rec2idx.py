@@ -62,11 +62,24 @@ def main():
     parser.add_argument("record", help="path to the .rec file")
     parser.add_argument("index", nargs="?", default=None,
                         help="output .idx path (default: .rec -> .idx)")
+    parser.add_argument("--no-native", action="store_true",
+                        help="force the pure-python scanner")
     args = parser.parse_args()
     idx = args.index or os.path.splitext(args.record)[0] + ".idx"
-    creator = IndexCreator(args.record, idx)
-    n = creator.create_index()
-    creator.close()
+
+    from mxnet_tpu import recordio_native
+
+    if not args.no_native and recordio_native.available():
+        # native scan: no per-frame Python overhead
+        offsets = recordio_native.native_index(args.record)
+        with open(idx, "w") as f:
+            for i, pos in enumerate(offsets):
+                f.write("%d\t%d\n" % (i, pos))
+        n = len(offsets)
+    else:
+        creator = IndexCreator(args.record, idx)
+        n = creator.create_index()
+        creator.close()
     print("wrote %s (%d records)" % (idx, n))
 
 
