@@ -41,11 +41,15 @@ def _load():
                 # pytest) must never dlopen a half-written .so — the
                 # per-process lock cannot serialize across processes
                 tmp = "%s.build.%d" % (_SO, os.getpid())
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     _SRC, "-o", tmp],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, _SO)
+                try:
+                    subprocess.run(
+                        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                         _SRC, "-o", tmp],
+                        check=True, capture_output=True, timeout=120)
+                    os.replace(tmp, _SO)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
             lib = ctypes.CDLL(_SO)
             # binding stays inside the try: a stale .so missing a
             # symbol must degrade to the python fallback, not raise
@@ -81,13 +85,20 @@ def native_index(path):
     lib = _load()
     if lib is None:
         raise RuntimeError("native recordio core unavailable")
-    path_b = os.fspath(path).encode()
-    # one pass: every frame costs >= 8 header bytes, so size//8 bounds
-    # the record count (a count-then-fill double scan would read the
-    # file twice and race concurrent appenders)
-    cap = max(1, os.path.getsize(path) // 8)
+    path_b = os.fsencode(os.fspath(path))
+    # single pass with a bounded buffer: size//8 bounds the record
+    # count (every frame costs >= 8 bytes) but allocating that many
+    # slots would equal the FILE size in RAM for huge .recs — cap the
+    # buffer and fall back to an exact count+fill double scan only in
+    # the many-tiny-records regime that overflows it.
+    cap = max(1, min(os.path.getsize(path) // 8, 1 << 24))
     arr = (ctypes.c_ulonglong * cap)()
     n = lib.rio_index(path_b, arr, cap)
+    if n == -4:
+        n = lib.rio_index(path_b, None, 0)        # exact count
+        _check(n, path)
+        arr = (ctypes.c_ulonglong * n)()
+        n = lib.rio_index(path_b, arr, n)
     _check(n, path)
     return list(arr[:n])
 
@@ -98,12 +109,18 @@ def native_read_at(path, offset):
     lib = _load()
     if lib is None:
         raise RuntimeError("native recordio core unavailable")
-    path_b = os.fspath(path).encode()
+    path_b = os.fsencode(os.fspath(path))
+    # one parse in the common case: try a typical-record buffer; on
+    # capacity miss the call still walked the chunks and reported the
+    # exact length, so a single retry suffices.
     length = ctypes.c_ulonglong()
-    rc = lib.rio_read_at(path_b, offset, None, 0, ctypes.byref(length))
-    _check(rc, path)
-    buf = (ctypes.c_ubyte * length.value)()
-    rc = lib.rio_read_at(path_b, offset, buf, length.value,
-                         ctypes.byref(length))
+    cap = 1 << 20
+    buf = (ctypes.c_ubyte * cap)()
+    rc = lib.rio_read_at(path_b, offset, buf, cap, ctypes.byref(length))
+    if rc == -4:
+        cap = length.value
+        buf = (ctypes.c_ubyte * cap)()
+        rc = lib.rio_read_at(path_b, offset, buf, cap,
+                             ctypes.byref(length))
     _check(rc, path)
     return bytes(buf[:length.value])

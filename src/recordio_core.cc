@@ -43,8 +43,10 @@ struct File {
   long long size;
   explicit File(const char* path) : f(std::fopen(path, "rb")), size(-1) {
     if (f) {
+      // fstat on the OPEN handle: a path-based stat could race a
+      // rename/unlink and falsely report every record truncated
       struct stat st;
-      if (::stat(path, &st) == 0) size = (long long)st.st_size;
+      if (::fstat(fileno(f), &st) == 0) size = (long long)st.st_size;
     }
   }
   ~File() { if (f) std::fclose(f); }
@@ -118,11 +120,13 @@ int rio_read_at(const char* path, unsigned long long offset,
     uint32_t cflag = cflag_of(lrec), len = len_of(lrec);
     long long end = pos + 8 + (long long)len + pad4(len);
     if (end > file.size) return -3;   // truncated payload
-    if (buf) {
-      if (total + len > cap) return -4;
+    bool fits = buf && total + len <= cap;
+    if (fits) {
       if (std::fread(buf + total, 1, len, file.f) != len) return -3;
       if (fseeko(file.f, (off_t)pad4(len), SEEK_CUR) != 0) return -3;
     } else {
+      // keep walking to compute the record's true length so the
+      // caller can size an exact buffer and retry once
       if (fseeko(file.f, (off_t)(len + pad4(len)), SEEK_CUR) != 0)
         return -3;
     }
@@ -131,7 +135,7 @@ int rio_read_at(const char* path, unsigned long long offset,
     if (cflag == 0 || cflag == 3) break;
   }
   *out_len = total;
-  return 0;
+  return (buf == nullptr || total <= cap) ? 0 : -4;
 }
 
 }  // extern "C"

@@ -164,3 +164,19 @@ def test_parse_log(monkeypatch):
     assert "| epoch |" in out and "0.800000" in out and "11.0" in out
     tsv = render(data, ["accuracy"], "none")
     assert tsv.splitlines()[0].startswith("epoch\t")
+
+
+def test_flakiness_checker(tmp_path, monkeypatch):
+    """Flakiness checker reports failing seeds reproducibly
+    (reference tools/flakiness_checker.py)."""
+    monkeypatch.syspath_prepend(os.path.join(_ROOT, "tools"))
+    import flakiness_checker
+
+    assert flakiness_checker.resolve_target("test_io.test_foo") == \
+        "tests/test_io.py::test_foo"
+    assert flakiness_checker.resolve_target(
+        "tests/test_io.py::test_foo") == "tests/test_io.py::test_foo"
+    out = _run([sys.executable, "tools/flakiness_checker.py",
+                "tests/test_lr_callback.py::test_scheduler_warmup",
+                "-n", "2"], timeout=300)
+    assert "0/2 trials failed" in out

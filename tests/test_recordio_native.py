@@ -97,3 +97,15 @@ def test_rec2idx_uses_native_path(tmp_path):
     for i in (8, 0, 4):
         assert reader.read_idx(i) == payloads[i]
     reader.close()
+
+
+def test_native_reads_large_records(tmp_path):
+    """Records bigger than the first-try 1MB buffer take the exact-size
+    retry path."""
+    rng = np.random.RandomState(7)
+    big = bytes(rng.randint(0, 256, 3 * 1024 * 1024, dtype=np.uint8))
+    path = tmp_path / "big.rec"
+    _write_rec(path, [b"small", big, b"tail"])
+    offsets = recordio_native.native_index(path)
+    assert recordio_native.native_read_at(path, offsets[1]) == big
+    assert recordio_native.native_read_at(path, offsets[2]) == b"tail"

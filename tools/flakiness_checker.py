@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Run a test many times to smoke out seed-dependent flakiness.
+
+Reference: tools/flakiness_checker.py — repeats one test under fresh
+random seeds (or a pinned MXNET_TEST_SEED, the knob tests/conftest.py
+honors and prints on failure), reporting the pass/fail tally and the
+first failing seed for reproduction.
+
+    python tools/flakiness_checker.py tests/test_rnn.py::test_foo -n 50
+    python tools/flakiness_checker.py test_rnn.test_foo -s 1234
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def resolve_target(spec):
+    """Accept pytest node ids (tests/test_x.py::test_y) and the
+    reference's module.test notation (test_x.test_y)."""
+    if "::" in spec or spec.endswith(".py") or os.sep in spec:
+        return spec
+    if "." in spec:
+        module, test = spec.rsplit(".", 1)
+        path = os.path.join("tests", module + ".py")
+        if os.path.exists(os.path.join(_ROOT, path)):
+            return "%s::%s" % (path, test)
+    return spec
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Check a test for seed flakiness")
+    parser.add_argument("test", help="pytest node id or module.test")
+    parser.add_argument("-n", "--num-trials", type=int, default=20,
+                        metavar="N", dest="trials")
+    parser.add_argument("-s", "--seed", type=int, default=None,
+                        help="pin MXNET_TEST_SEED (default: fresh "
+                        "random seed per trial)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    target = resolve_target(args.test)
+    failures = []
+    for trial in range(args.trials):
+        seed = args.seed if args.seed is not None \
+            else random.randrange(0, 2 ** 31)
+        env = dict(os.environ, MXNET_TEST_SEED=str(seed))
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", target, "-q", "-x"],
+            env=env, cwd=_ROOT, capture_output=True, text=True)
+        status = "PASS" if res.returncode == 0 else "FAIL"
+        if args.verbose or status == "FAIL":
+            print("trial %3d seed %10d: %s" % (trial, seed, status))
+        if res.returncode != 0:
+            failures.append(seed)
+    print("%d/%d trials failed" % (len(failures), args.trials))
+    if failures:
+        print("reproduce with: MXNET_TEST_SEED=%d python -m pytest %s"
+              % (failures[0], target))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
