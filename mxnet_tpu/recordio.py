@@ -198,9 +198,40 @@ class MXIndexedRecordIO(MXRecordIO):
         self.record.seek(self.idx[idx])
 
     def read_idx(self, idx):
-        """Random-access read of record `idx`."""
+        """Random-access read of record `idx`.
+
+        Uses the C++ core's stateless per-call read when available (the
+        data pipeline's shuffled-read hot path: no per-frame Python
+        parsing, and inherently fork-safe since each call opens its own
+        handle); MXNET_USE_NATIVE_RECORDIO=0 forces the python path.
+        Either path leaves the sequential position just past the record
+        and rejects closed handles, so behavior is backend-independent."""
+        if self._native_reads():
+            from . import recordio_native
+
+            assert not self.writable
+            # same closed/forked-handle recovery as the python path
+            # (seek's _check_pid reopens after close/fork)
+            self._check_pid(allow_reset=True)
+            data, end = recordio_native.native_read_at(self.uri,
+                                                       self.idx[idx])
+            self.record.seek(end)     # parity with seek+read
+            return data
         self.seek(idx)
         return self.read()
+
+    _native_ok = None
+
+    def _native_reads(self):
+        cls = type(self)
+        if cls._native_ok is None:
+            if os.environ.get("MXNET_USE_NATIVE_RECORDIO", "1") == "0":
+                cls._native_ok = False
+            else:
+                from . import recordio_native
+
+                cls._native_ok = recordio_native.available()
+        return cls._native_ok and not self.writable
 
     def write_idx(self, idx, buf):
         """Append record and index it under key `idx`."""

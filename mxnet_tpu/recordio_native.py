@@ -61,6 +61,7 @@ def _load():
             lib.rio_read_at.argtypes = [
                 ctypes.c_char_p, ctypes.c_ulonglong,
                 ctypes.POINTER(ctypes.c_ubyte), ctypes.c_ulonglong,
+                ctypes.POINTER(ctypes.c_ulonglong),
                 ctypes.POINTER(ctypes.c_ulonglong)]
         except (OSError, subprocess.SubprocessError,
                 FileNotFoundError, AttributeError):
@@ -103,9 +104,24 @@ def native_index(path):
     return list(arr[:n])
 
 
+_tls = threading.local()
+
+
+def _scratch(cap):
+    """Reusable per-thread read buffer (a fresh ctypes buffer is
+    zero-initialized every call — measurable on per-frame hot paths)."""
+    buf = getattr(_tls, "buf", None)
+    if buf is None or len(buf) < cap:
+        buf = (ctypes.c_ubyte * cap)()
+        _tls.buf = buf
+    return buf
+
+
 def native_read_at(path, offset):
     """One logical record (continuation chunks reassembled) starting at
-    `offset`, as bytes."""
+    `offset`. Returns (bytes, end_offset) where end_offset is the file
+    position just past the record — callers mirroring a sequential
+    handle seek there."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native recordio core unavailable")
@@ -114,13 +130,14 @@ def native_read_at(path, offset):
     # capacity miss the call still walked the chunks and reported the
     # exact length, so a single retry suffices.
     length = ctypes.c_ulonglong()
+    end = ctypes.c_ulonglong()
     cap = 1 << 20
-    buf = (ctypes.c_ubyte * cap)()
-    rc = lib.rio_read_at(path_b, offset, buf, cap, ctypes.byref(length))
+    buf = _scratch(cap)
+    rc = lib.rio_read_at(path_b, offset, buf, len(buf),
+                         ctypes.byref(length), ctypes.byref(end))
     if rc == -4:
-        cap = length.value
-        buf = (ctypes.c_ubyte * cap)()
-        rc = lib.rio_read_at(path_b, offset, buf, cap,
-                             ctypes.byref(length))
+        buf = _scratch(length.value)
+        rc = lib.rio_read_at(path_b, offset, buf, len(buf),
+                             ctypes.byref(length), ctypes.byref(end))
     _check(rc, path)
-    return bytes(buf[:length.value])
+    return bytes(buf[:length.value]), end.value

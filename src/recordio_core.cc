@@ -10,10 +10,12 @@
 // Exposed C ABI (loaded from python via ctypes, no pybind11):
 //   rio_index(path, offsets, cap)            -> n_records | -errno-ish
 //       Scan the file, writing each logical record's start offset.
-//   rio_read_at(path, offset, buf, cap, len*) -> 0 | error code
+//   rio_read_at(path, offset, buf, cap, len*, end*) -> 0 | error code
 //       Read ONE logical record (reassembling continuation chunks)
-//       starting at `offset` into buf; *len receives the byte count.
-//       buf may be null to query the length only.
+//       starting at `offset` into buf; *len receives the byte count
+//       and *end (nullable) the file offset just past the record —
+//       callers keeping a sequential handle seek there for parity
+//       with a read-through. buf may be null to query lengths only.
 //
 // Error codes: -1 open failed, -2 bad magic, -3 truncated,
 // -4 capacity exceeded.
@@ -105,7 +107,8 @@ long long rio_index(const char* path, unsigned long long* offsets,
 
 int rio_read_at(const char* path, unsigned long long offset,
                 unsigned char* buf, unsigned long long cap,
-                unsigned long long* out_len) {
+                unsigned long long* out_len,
+                unsigned long long* out_end) {
   File file(path);
   if (!file.f) return -1;
   if (fseeko(file.f, (off_t)offset, SEEK_SET) != 0) return -3;
@@ -135,6 +138,7 @@ int rio_read_at(const char* path, unsigned long long offset,
     if (cflag == 0 || cflag == 3) break;
   }
   *out_len = total;
+  if (out_end) *out_end = (unsigned long long)pos;
   return (buf == nullptr || total <= cap) ? 0 : -4;
 }
 

@@ -34,7 +34,7 @@ def test_native_index_matches_python_scan(tmp_path):
     # python reader agrees record-by-record at each native offset
     reader = mx.recordio.MXRecordIO(str(path), "r")
     for i, payload in enumerate(payloads):
-        got = recordio_native.native_read_at(path, offsets[i])
+        got, _ = recordio_native.native_read_at(path, offsets[i])
         assert got == payload
         assert reader.read() == payload
     reader.close()
@@ -56,9 +56,9 @@ def test_native_reads_chunked_records(tmp_path):
 
     offsets = recordio_native.native_index(path)
     assert len(offsets) == 2                  # one chunked + one whole
-    assert recordio_native.native_read_at(path, offsets[0]) == \
+    assert recordio_native.native_read_at(path, offsets[0])[0] == \
         b"".join(parts)
-    assert recordio_native.native_read_at(path, offsets[1]) == b"end"
+    assert recordio_native.native_read_at(path, offsets[1])[0] == b"end"
     reader = mx.recordio.MXRecordIO(str(path), "r")
     assert reader.read() == b"".join(parts)
     assert reader.read() == b"end"
@@ -107,5 +107,59 @@ def test_native_reads_large_records(tmp_path):
     path = tmp_path / "big.rec"
     _write_rec(path, [b"small", big, b"tail"])
     offsets = recordio_native.native_index(path)
-    assert recordio_native.native_read_at(path, offsets[1]) == big
-    assert recordio_native.native_read_at(path, offsets[2]) == b"tail"
+    assert recordio_native.native_read_at(path, offsets[1])[0] == big
+    assert recordio_native.native_read_at(path, offsets[2])[0] == b"tail"
+
+
+def test_indexed_reader_native_path_matches_python(tmp_path):
+    """MXIndexedRecordIO.read_idx returns identical bytes through the
+    native fast path and the forced-python path."""
+    rng = np.random.RandomState(9)
+    payloads = [bytes(rng.randint(0, 256, rng.randint(1, 2000),
+                                  dtype=np.uint8)) for _ in range(12)]
+    rec_path, idx_path = str(tmp_path / "r.rec"), str(tmp_path / "r.idx")
+    w = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+
+    r = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    native = [r.read_idx(i) for i in (5, 0, 11, 3)]
+    r.close()
+    old = mx.recordio.MXIndexedRecordIO._native_ok
+    mx.recordio.MXIndexedRecordIO._native_ok = False     # force python
+    try:
+        r = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+        python = [r.read_idx(i) for i in (5, 0, 11, 3)]
+        r.close()
+    finally:
+        mx.recordio.MXIndexedRecordIO._native_ok = old
+    assert native == python == [payloads[i] for i in (5, 0, 11, 3)]
+
+
+def test_indexed_reader_position_parity_and_closed_handle(tmp_path):
+    """read_idx leaves the sequential position just past the record on
+    BOTH backends, and closed readers fail on both."""
+    payloads = [b"one1", b"two22222", b"three"]
+    rec_path, idx_path = str(tmp_path / "p.rec"), str(tmp_path / "p.idx")
+    w = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+
+    for force_python in (False, True):
+        old = mx.recordio.MXIndexedRecordIO._native_ok
+        if force_python:
+            mx.recordio.MXIndexedRecordIO._native_ok = False
+        try:
+            r = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+            assert r.read_idx(0) == payloads[0]
+            # sequential read continues AFTER record 0 on either path
+            assert r.read() == payloads[1]
+            # closed handles auto-reopen on the next read (the python
+            # path's _check_pid reset; the native path matches)
+            r.close()
+            assert r.read_idx(1) == payloads[1]
+            r.close()
+        finally:
+            mx.recordio.MXIndexedRecordIO._native_ok = old
