@@ -20,14 +20,20 @@ def _devices_by_type():
     import jax
 
     out = {"cpu": [], "tpu": []}
-    for d in jax.devices():
+    # local_devices, not devices: in a multi-process SPMD group
+    # (parallel.dist.initialize) the global list includes other hosts'
+    # chips, which this process cannot address — imperative work is
+    # per-process, exactly as each reference worker computes on its own
+    # GPUs and only kvstore/collectives cross hosts.
+    for d in jax.local_devices():
         kind = "cpu" if d.platform == "cpu" else "tpu"
         out[kind].append(d)
     # When running on an accelerator backend, host CPU devices are still
     # reachable for host-resident arrays.
     if not out["cpu"]:
         try:
-            out["cpu"] = jax.devices("cpu")
+            out["cpu"] = [d for d in jax.devices("cpu")
+                          if d.process_index == jax.process_index()]
         except RuntimeError:
             out["cpu"] = []
     return out

@@ -17,7 +17,12 @@ TPU-native equivalents here subsume all three:
 - Sequence parallelism / ring attention for long context lives in
   `ring_attention.py` (the reference has none — SURVEY.md §5.7; this is
   TPU-first new capability).
+- Multi-host SPMD over DCN lives in `dist.py`: `dist.initialize()` forms
+  a cross-process group from the DMLC_* launch contract, after which the
+  same mesh/TrainStep code spans hosts (replacing ps-lite's scheduler +
+  ZMQ transport, kvstore_dist.h:44-450).
 """
+from . import dist
 from .mesh import make_mesh, data_sharding, replicate, shard_params
 from .train_step import TrainStep
 from .ring_attention import (ring_attention, ring_self_attention,
@@ -25,4 +30,4 @@ from .ring_attention import (ring_attention, ring_self_attention,
 
 __all__ = ["make_mesh", "data_sharding", "replicate", "shard_params",
            "TrainStep", "ring_attention", "ring_self_attention",
-           "blockwise_attention"]
+           "blockwise_attention", "dist"]

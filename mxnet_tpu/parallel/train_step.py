@@ -61,10 +61,17 @@ class TrainStep:
         back to the fp32 masters. This is the reference's multi_precision
         / mp_sgd_update contract (python/mxnet/optimizer.py:201-266,
         src/operator/optimizer_op.cc mp_sgd) in XLA form.
+    deterministic_reduction : bool — aggregate gradients in explicit
+        shard order (see `_make_deterministic_grad`) so training state
+        is bit-for-bit identical across process topologies (1 host vs
+        N hosts of the same mesh). dp-only meshes; slightly more
+        bandwidth (all_gather instead of fused psum).
     """
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, param_rule=None, dtype=None):
+                 mesh=None, param_rule=None, dtype=None,
+                 deterministic_reduction=False):
+        self.deterministic_reduction = bool(deterministic_reduction)
         self.net = net
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -94,6 +101,7 @@ class TrainStep:
         self._param_rule = param_rule
         self._jitted = None
         self._materialized = False
+        self._multiproc = False
 
     def _make_opt_rule(self):
         """(n_states, update_fn) for the configured optimizer.
@@ -227,9 +235,23 @@ class TrainStep:
             "adagrad/adadelta/ftrl (got %r); for other optimizers use "
             "gluon.Trainer" % self.optimizer)
 
+    def _place(self, value, sharding):
+        """Lay a host/default-device array out on the (possibly
+        cross-process) mesh. Single-process: plain device_put. Multi-
+        process: every process holds the full value (identical seeds →
+        identical init, the dist_sync contract), and each fills only its
+        addressable shards."""
+        if not self._multiproc:
+            return jax.device_put(value, sharding)
+        host = np.asarray(value)
+        return jax.make_array_from_callback(host.shape, sharding,
+                                            lambda idx: host[idx])
+
     def _materialize(self, x_example):
         """Collect param values (triggering deferred init with a real
         forward if needed) and lay them out on the mesh."""
+        self._multiproc = any(d.process_index != jax.process_index()
+                              for d in self.mesh.devices.flat)
         net = self.net
         params = list(net.collect_params().values())
         if any(p._data is None and p._deferred_init is not None
@@ -263,16 +285,79 @@ class TrainStep:
         self._repl = replicate(self.mesh)
 
         # Place params/aux/state according to the sharding plan.
-        self._param_vals = {n: jax.device_put(v, self._shardings[n])
+        self._param_vals = {n: self._place(v, self._shardings[n])
                             for n, v in self._param_vals.items()}
-        self._aux_vals = {n: jax.device_put(v, self._repl)
+        self._aux_vals = {n: self._place(v, self._repl)
                           for n, v in self._aux_vals.items()}
         self._opt_state = {
-            n: tuple(jax.device_put(s, self._shardings[n]) for s in st)
+            n: tuple(self._place(s, self._shardings[n]) for s in st)
             for n, st in self._opt_state.items()}
         self._materialized = True
 
     # -- the pure step --------------------------------------------------------
+
+    def _make_deterministic_grad(self, loss_of):
+        """Topology-invariant gradient aggregation (beyond reference).
+
+        The GSPMD path lets XLA insert a `psum` for the sharded-batch
+        mean gradient; its reduction order depends on the collective
+        implementation (single-host shared-memory vs cross-host ring),
+        so a 2-host run differs from a 1-host run in the last float bit.
+        This mode computes per-shard gradients under `shard_map`, then
+        `all_gather`s them and sums the shards in explicit ascending
+        mesh order — an unrolled chain of adds whose order is part of
+        the program, not the transport. Training state then matches
+        bit-for-bit across any process topology of the same mesh.
+
+        Restrictions: dp-only meshes (params replicated) — the point is
+        multi-host data parallelism; and BatchNorm aux stats become the
+        ordered mean of per-shard stats (same mean, variance of shard
+        means differs from global-batch variance at O(1/B²)).
+        """
+        mesh = self.mesh
+        for ax in mesh.axis_names:
+            if ax != "dp" and mesh.shape[ax] != 1:
+                raise ValueError(
+                    "deterministic_reduction supports dp-only meshes; "
+                    "got axis %r of size %d" % (ax, mesh.shape[ax]))
+        try:
+            shard_map = jax.shard_map
+            no_check = {"check_vma": False}
+        except AttributeError:  # older jax spelling (and kwarg name)
+            from jax.experimental.shard_map import shard_map
+            no_check = {"check_rep": False}
+        ndp = mesh.shape["dp"]
+
+        def ordered_mean(gathered):
+            # gathered: (ndp, ...) from all_gather — reduce in explicit
+            # shard order so the float rounding is identical everywhere.
+            acc = gathered[0]
+            for i in range(1, ndp):
+                acc = acc + gathered[i]
+            return acc / ndp
+
+        def per_shard(pvals, aux_vals, xs, ys, key):
+            (loss, new_aux), g = jax.value_and_grad(
+                loss_of, has_aux=True)(pvals, aux_vals, xs, ys, key)
+            gather = lambda t: jax.tree_util.tree_map(
+                lambda a: ordered_mean(jax.lax.all_gather(a, "dp")), t)
+            return gather(loss), gather(new_aux), gather(g)
+
+        data_spec = P(tuple(a for a in ("dp",) if a in mesh.axis_names))
+        rep = P()
+
+        def grad_of(pvals, aux_vals, x, y, key):
+            # check_vma=False: outputs ARE replicated (all_gather +
+            # identical per-device arithmetic) but the static checker
+            # cannot infer it through the gathered-and-resummed chain.
+            loss, new_aux, grads = shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(rep, rep, data_spec, data_spec, rep),
+                out_specs=(rep, rep, rep),
+                **no_check)(pvals, aux_vals, x, y, key)
+            return (loss, new_aux), grads
+
+        return grad_of
 
     def _build(self):
         net, loss_fn = self.net, self.loss_fn
@@ -314,9 +399,15 @@ class TrainStep:
 
         opt_update = self._opt_update
 
+        if self.deterministic_reduction:
+            grad_of = self._make_deterministic_grad(loss_of)
+        else:
+            def grad_of(pvals, aux_vals, x, y, key):
+                return jax.value_and_grad(loss_of, has_aux=True)(
+                    pvals, aux_vals, x, y, key)
+
         def step(pvals, opt_state, aux_vals, x, y, lr, t, key):
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(pvals, aux_vals, x, y, key)
+            (loss, new_aux), grads = grad_of(pvals, aux_vals, x, y, key)
             new_p, new_s = {}, {}
             for name, p in pvals.items():
                 g = grads[name].astype(jnp.float32)
@@ -341,7 +432,14 @@ class TrainStep:
     # -- public API -----------------------------------------------------------
 
     def __call__(self, x, y):
-        """Run one training step; returns the (host) scalar loss."""
+        """Run one training step; returns the (host) scalar loss.
+
+        Multi-process meshes (after `parallel.dist.initialize`): `x`/`y`
+        are this process's *local* slice of the global batch
+        (`dist.local_slice` gives the rows) — the global array is
+        assembled across processes, exactly how each reference worker
+        feeds its own `num_parts`/`part_index` shard of the epoch.
+        """
         if isinstance(x, NDArray):
             x = x._data
         if isinstance(y, NDArray):
@@ -350,8 +448,14 @@ class TrainStep:
             self._materialize(np.asarray(x)[:1])
         if self._jitted is None:
             self._build()
-        x = jax.device_put(jnp.asarray(x), self._data_sharding)
-        y = jax.device_put(jnp.asarray(y), self._data_sharding)
+        if self._multiproc:
+            x = jax.make_array_from_process_local_data(
+                self._data_sharding, np.asarray(x))
+            y = jax.make_array_from_process_local_data(
+                self._data_sharding, np.asarray(y))
+        else:
+            x = jax.device_put(jnp.asarray(x), self._data_sharding)
+            y = jax.device_put(jnp.asarray(y), self._data_sharding)
         self.num_update += 1
         key = _random.next_key()
         (self._param_vals, self._opt_state, self._aux_vals,
@@ -359,15 +463,51 @@ class TrainStep:
                               self._aux_vals, x, y,
                               jnp.float32(self.lr),
                               jnp.float32(self.num_update), key)
+        if self._multiproc:
+            # The replicated loss is not fully addressable from one
+            # controller; hand back this process's local replica so the
+            # return type (a scalar jax array) matches single-process
+            # and dispatch stays async.
+            return loss.addressable_data(0)
         return loss
 
     def set_learning_rate(self, lr):
         self.lr = float(lr)
 
+    def _gather_host(self, tree):
+        """Pytree of global arrays -> pytree of host numpy, valid on
+        every process. Shards are re-replicated through a jitted
+        identity (an all-gather over the mesh), then read locally."""
+        if not self._multiproc:
+            return jax.device_get(tree)
+        if not hasattr(self, "_rep_identity"):
+            # One stable jitted identity so repeated gathers hit the
+            # executable cache instead of retracing per call.
+            self._rep_identity = jax.jit(lambda t: t,
+                                         out_shardings=self._repl)
+        rep = self._rep_identity(tree)
+        return jax.tree_util.tree_map(
+            lambda a: np.asarray(a.addressable_data(0)), rep)
+
+    def state_to_host(self):
+        """(params, opt_state, aux) as host numpy dicts on every
+        process — the checkpoint/inspection surface for multi-host runs
+        (each reference worker could pull full weights from the servers;
+        kvstore_dist.h:217)."""
+        return (self._gather_host(self._param_vals),
+                self._gather_host(self._opt_state),
+                self._gather_host(self._aux_vals))
+
     def sync_to_net(self):
         """Copy the (possibly sharded) param values back into the net's
         Parameters (gather happens lazily on host read)."""
+        if self._multiproc:
+            # Gather only params + aux — optimizer state stays put.
+            pvals = self._gather_host(self._param_vals)
+            avals = self._gather_host(self._aux_vals)
+        else:
+            pvals, avals = self._param_vals, self._aux_vals
         for p in self._train_params:
-            p.set_data(NDArray(self._param_vals[p.name]))
+            p.set_data(NDArray(pvals[p.name]))
         for p in self._aux_params:
-            p.set_data(NDArray(self._aux_vals[p.name]))
+            p.set_data(NDArray(avals[p.name]))

@@ -55,7 +55,7 @@ def get_gpu_memory(gpu_dev_id=0):
     import jax
 
     try:
-        dev = jax.devices()[gpu_dev_id]
+        dev = jax.local_devices()[gpu_dev_id]
         stats = dev.memory_stats()
         total = stats.get("bytes_limit", -1)
         used = stats.get("bytes_in_use", 0)

@@ -1,0 +1,87 @@
+"""Worker program for the multi-host SPMD equivalence test.
+
+Launched by tools/launch.py with ``-s 0`` (pure SPMD: N worker
+processes, no parameter server), or run directly as the 1-process
+reference. Either way it trains the same tiny model as
+tests/test_parallel.py's convergence case for a fixed number of steps
+over an 8-device 'dp' mesh — 8 local devices single-process, or
+N processes × (8/N) local devices each after `dist.initialize` — and
+writes the final params + optimizer state + loss trace to an .npz.
+
+The single-process and multi-process runs must agree (the reference's
+dist_sync contract: tests/nightly/dist_sync_kvstore.py asserts pushed
+gradients aggregate identically whatever the worker count).
+
+Usage: dist_spmd_prog.py OUT.npz [steps]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu.parallel import dist
+
+# Pin CPU + per-process virtual device count before any backend touch.
+_, nproc, _ = dist.env_spec()
+nproc = nproc or 1
+if 8 % nproc:
+    sys.exit("worker count %d must divide the 8-device mesh" % nproc)
+dist.initialize(local_device_count=8 // nproc, platform="cpu")
+
+import jax  # noqa: E402  (backend config above must come first)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.parallel import make_mesh, TrainStep  # noqa: E402
+
+
+def main():
+    out_path = sys.argv[1]
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+
+    assert len(jax.devices()) == 8, jax.devices()
+    mesh = make_mesh({"dp": 8})
+
+    mx.random.seed(42)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu", in_units=10))
+    net.add(gluon.nn.Dense(2, in_units=32))
+    net.initialize()
+
+    # deterministic_reduction: gradient aggregation in explicit shard
+    # order, so 1-process and N-process runs agree bit-for-bit (the
+    # transport — shared memory vs gloo/DCN — stops mattering).
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     optimizer="adam",
+                     optimizer_params={"learning_rate": 0.05,
+                                       "wd": 1e-4},
+                     mesh=mesh, deterministic_reduction=True)
+
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        X = rng.randn(64, 10).astype(np.float32)
+        w = rng.randn(10).astype(np.float32)
+        Y = (X @ w > 0).astype(np.float32)
+        lo, hi = dist.local_slice(64)
+        loss = step(X[lo:hi], Y[lo:hi])
+        losses.append(float(np.asarray(jax.device_get(loss))))
+
+    params, opt_state, aux = step.state_to_host()
+    if dist.rank() == 0:
+        flat = {"loss": np.asarray(losses)}
+        for n, v in params.items():
+            flat["param:" + n] = v
+        for n, st in opt_state.items():
+            for i, s in enumerate(st):
+                flat["opt:%s:%d" % (n, i)] = s
+        for n, v in aux.items():
+            flat["aux:" + n] = v
+        np.savez(out_path, **flat)
+    dist.barrier("dist_spmd_done")
+
+
+if __name__ == "__main__":
+    main()

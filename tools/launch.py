@@ -41,6 +41,11 @@ def launch_local(num_workers, num_servers, cmd, env_extra=None,
     Returns the list of worker exit codes. `worker_envs` optionally gives
     per-worker env overrides (e.g. to pin each worker to its own
     device set).
+
+    ``num_servers=0`` launches a pure SPMD job: no scheduler or server
+    processes — just N workers, each with its rank in DMLC_WORKER_ID,
+    and the root URI/port free for `parallel.dist.initialize` to use as
+    the jax.distributed coordinator (rank 0 binds it).
     """
     port = _free_port()
     base = dict(os.environ)
@@ -60,13 +65,17 @@ def launch_local(num_workers, num_servers, cmd, env_extra=None,
         return subprocess.Popen(cmd, env=env)
 
     try:
-        procs.append(spawn("scheduler"))
-        for _ in range(num_servers):
-            procs.append(spawn("server"))
+        if num_servers > 0:
+            procs.append(spawn("scheduler"))
+            for _ in range(num_servers):
+                procs.append(spawn("server"))
         workers = []
         for i in range(num_workers):
             extra = dict(worker_envs[i]) if worker_envs else {}
-            workers.append(spawn("worker", extra))
+            extra.setdefault("DMLC_WORKER_ID", str(i))
+            w = spawn("worker", extra)
+            workers.append(w)
+            procs.append(w)  # the finally below must reap hung workers too
         codes = [w.wait(timeout=timeout) for w in workers]
         return codes
     finally:
