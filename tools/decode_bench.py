@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput: ImageRecordIter img/s vs preprocess_threads.
+
+The reference measures its input path with the OpenMP decode team of
+ImageRecordIOParser2 (iter_image_recordio_2.cc); this is the equivalent
+standing benchmark for the rebuild's decode worker team. Writes one JSON
+line per configuration so round notes can quote a table.
+
+Usage: python tools/decode_bench.py [--size 224] [--n 256] [--batches 8]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_dataset(tmpdir, n, size):
+    from mxnet_tpu import recordio
+
+    rec = os.path.join(tmpdir, "bench.rec")
+    idx = os.path.join(tmpdir, "bench.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        # Realistic JPEG work: natural-image-like low-frequency content
+        # (pure noise JPEGs decode unrealistically slowly/quickly).
+        base = rng.rand(16, 16, 3)
+        im = np.kron(base, np.ones((size // 16, size // 16, 1)))
+        im = ((im + 0.1 * rng.rand(size, size, 3)) * 200).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 10), i, 0), im, img_fmt=".jpg",
+            quality=90))
+    w.close()
+    return rec, idx
+
+
+def bench(rec, idx, size, batch_size, batches, threads):
+    from mxnet_tpu import image
+
+    it = image.ImageIter(batch_size=batch_size, data_shape=(3, size, size),
+                         path_imgrec=rec, path_imgidx=idx,
+                         rand_crop=True, rand_mirror=True, resize=size + 32,
+                         mean=True, std=True, preprocess_threads=threads)
+    next(it)  # warm (pool spin-up, cv2 first-call costs)
+    it.reset()
+    n_img = 0
+    t0 = time.monotonic()
+    for _ in range(batches):
+        try:
+            b = next(it)
+        except StopIteration:
+            it.reset()
+            b = next(it)
+        n_img += b.data[0].shape[0] - b.pad
+    dt = time.monotonic() - t0
+    it.close()
+    return n_img / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--batches", type=int, default=6)
+    ap.add_argument("--threads", type=str, default="0,2,4,8")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    mx.util.pin_platform("cpu")
+    with tempfile.TemporaryDirectory() as td:
+        rec, idx = make_dataset(td, args.n, args.size)
+        for t in (int(x) for x in args.threads.split(",")):
+            rate = bench(rec, idx, args.size, args.batch_size,
+                         args.batches, t)
+            print(json.dumps({
+                "metric": "decode_img_per_s", "value": round(rate, 1),
+                "unit": "img/s", "preprocess_threads": t,
+                "size": args.size, "host_cores": os.cpu_count()}))
+
+
+if __name__ == "__main__":
+    main()
