@@ -1,15 +1,18 @@
 """Bucketed sentence iterator for variable-length sequence training.
 
-Reference: python/mxnet/rnn/io.py (BucketSentenceIter, encode_sentences).
+Reference: python/mxnet/rnn/io.py (BucketSentenceIter, encode_sentences)
+— same API and bucketing semantics, independent implementation.
 
 TPU rebuild: buckets map 1:1 to compiled executables — each distinct
 bucket length triggers one XLA compile via the per-shape executable
 cache (BucketingModule rebind, SURVEY.md §5.7), after which steps are
-cache hits. Data is padded per bucket on the host, batches transfer
-whole to HBM.
+cache hits. Each bucket here is one padded host matrix with its
+next-token labels precomputed once; an epoch is a shuffled schedule of
+(bucket, row-offset) slices, so per-batch work is a view + one transfer.
 """
 from __future__ import annotations
 
+import logging
 import random
 
 import numpy as np
@@ -22,66 +25,102 @@ __all__ = ["BucketSentenceIter", "encode_sentences"]
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0, unknown_token=None):
     """Encode tokenized sentences to integer ids, building `vocab` on the
-    fly (reference rnn/io.py:encode_sentences)."""
-    idx = start_label
+    fly (reference rnn/io.py:encode_sentences).
+
+    With a caller-provided vocab, unseen words either map to
+    ``unknown_token`` or raise; fresh ids continue above the vocab's
+    current maximum so they can never collide with existing entries.
+    """
     if vocab is None:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
+        grow = True
+        next_id = start_label
     else:
-        new_vocab = False
-        idx = max(max(vocab.values()) + 1, idx)
-    res = []
-    for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                if not new_vocab:
-                    if unknown_token:
-                        word = unknown_token
-                    else:
-                        raise ValueError("Unknown token %s" % word)
-                else:
-                    if idx == invalid_label:
-                        idx += 1
-                    vocab[word] = idx
-                    idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+        grow = False
+        next_id = max(start_label, max(vocab.values()) + 1)
+
+    def encode(word):
+        nonlocal next_id
+        got = vocab.get(word)
+        if got is not None:
+            return got
+        if not grow:
+            if not unknown_token:
+                raise ValueError("Unknown token %s" % word)
+            # Lazily adopt the unknown token the first time an OOV word
+            # actually occurs — a fully in-vocabulary corpus leaves the
+            # caller's dict untouched.
+            if unknown_token not in vocab:
+                vocab[unknown_token] = next_id
+                next_id += 1
+            return vocab[unknown_token]
+        if next_id == invalid_label:  # never hand out the invalid id
+            next_id += 1
+        vocab[word] = next_id
+        next_id += 1
+        return vocab[word]
+
+    return [[encode(w) for w in sent] for sent in sentences], vocab
 
 
 class BucketSentenceIter(DataIter):
     """Pads encoded sentences into per-length buckets and yields batches
     with a `bucket_key` for BucketingModule (reference
-    rnn/io.py:BucketSentenceIter)."""
+    rnn/io.py:BucketSentenceIter). Labels are the input shifted one step
+    left (next-token LM targets), padded with ``invalid_label``.
+    """
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
                  data_name="data", label_name="softmax_label", dtype="float32",
                  layout="NT"):
         super().__init__(batch_size)
-        if not buckets:
-            counts = np.bincount([len(s) for s in sentences])
-            buckets = [i for i, j in enumerate(counts)
-                       if j >= batch_size]
+        lengths = [len(s) for s in sentences]
+        auto_buckets = not buckets
+        if auto_buckets:
+            # Auto buckets: every length frequent enough to fill at
+            # least one batch; if nothing qualifies, one bucket that
+            # fits everything.
+            freq = np.bincount(lengths)
+            buckets = [n for n in range(len(freq)) if freq[n] >= batch_size]
             if not buckets:
-                buckets = [max(len(s) for s in sentences)]
-        buckets.sort()
-        self.data = [[] for _ in buckets]
-        ndiscard = 0
-        for sent in sentences:
-            buck = np.searchsorted(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
-        if ndiscard:
-            import logging
+                buckets = [max(lengths)]
+        buckets = sorted(buckets)
 
+        # Assign each sentence to the smallest bucket that holds it;
+        # longer ones are dropped (the reference's discard contract).
+        rows = {b: [] for b in buckets}
+        dropped = 0
+        for sent in sentences:
+            fit = np.searchsorted(buckets, len(sent))
+            if fit == len(buckets):
+                dropped += 1
+            else:
+                rows[buckets[fit]].append(sent)
+        if dropped:
             logging.info("discarded %d sentences longer than the largest "
-                         "bucket", ndiscard)
+                         "bucket", dropped)
+        # Dead-bucket pruning — auto-generated buckets only: an unused
+        # auto bucket would just waste a compiled executable, but
+        # explicit buckets are a declared shape contract (train and val
+        # iterators built with the same list must advertise the same
+        # default_bucket_key / provide_data even if one split happens to
+        # miss some lengths).
+        if auto_buckets:
+            buckets = [b for b in buckets if rows[b]]
+
+        def pad_block(b):
+            block = np.full((len(rows[b]), b), invalid_label, dtype=dtype)
+            for r, sent in enumerate(rows[b]):
+                block[r, :len(sent)] = sent
+            return block
+
+        self.data = [pad_block(b) for b in buckets]
+        # Next-token labels, computed once: shift left, tail padded.
+        self.labels = []
+        for block in self.data:
+            lab = np.roll(block, -1, axis=1)
+            lab[:, -1] = invalid_label
+            self.labels.append(lab)
 
         self.batch_size = batch_size
         self.buckets = buckets
@@ -89,76 +128,64 @@ class BucketSentenceIter(DataIter):
         self.label_name = label_name
         self.dtype = dtype
         self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
-        self.major_axis = layout.find("N")
         self.layout = layout
-        self.default_bucket_key = max(buckets)
-
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(
-                data_name, (batch_size, self.default_bucket_key),
-                layout=layout)]
-            self.provide_label = [DataDesc(
-                label_name, (batch_size, self.default_bucket_key),
-                layout=layout)]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(
-                data_name, (self.default_bucket_key, batch_size),
-                layout=layout)]
-            self.provide_label = [DataDesc(
-                label_name, (self.default_bucket_key, batch_size),
-                layout=layout)]
-        else:
+        self.major_axis = layout.find("N")
+        if self.major_axis not in (0, 1):
             raise ValueError("Invalid layout %s: Must by NT (batch major) "
                              "or TN (time major)" % layout)
+        self.default_bucket_key = max(buckets)
+        self.provide_data = [DataDesc(
+            data_name, self._batch_shape(self.default_bucket_key),
+            layout=layout)]
+        self.provide_label = [DataDesc(
+            label_name, self._batch_shape(self.default_bucket_key),
+            layout=layout)]
 
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in
-                             range(0, len(buck) - batch_size + 1,
-                                   batch_size)])
-        self.curr_idx = 0
+        # An epoch = every full batch_size window of every bucket, in
+        # shuffled order. Built once; reshuffled per reset.
+        self._schedule = [(bi, off)
+                          for bi, block in enumerate(self.data)
+                          for off in range(0,
+                                           len(block) - batch_size + 1,
+                                           batch_size)]
+        self._cursor = 0
+        self.nddata = []
+        self.ndlabel = []
         self.reset()
+
+    def _batch_shape(self, seq_len):
+        if self.major_axis == 0:
+            return (self.batch_size, seq_len)
+        return (seq_len, self.batch_size)
 
     def reset(self):
         from .. import ndarray as nd
 
-        self.curr_idx = 0
-        random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-
+        self._cursor = 0
+        random.shuffle(self._schedule)
         self.nddata = []
         self.ndlabel = []
-        for buck in self.data:
-            if len(buck) == 0:
-                self.nddata.append(None)
-                self.ndlabel.append(None)
-                continue
-            # label = input shifted one step left (next-token LM target)
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(nd.array(buck, dtype=self.dtype))
-            self.ndlabel.append(nd.array(label, dtype=self.dtype))
+        for block, lab in zip(self.data, self.labels):
+            # One permutation reorders data and labels together, so the
+            # pairing survives the per-epoch shuffle.
+            perm = np.random.permutation(len(block))
+            block[:] = block[perm]
+            lab[:] = lab[perm]
+            self.nddata.append(nd.array(block, dtype=self.dtype))
+            self.ndlabel.append(nd.array(lab, dtype=self.dtype))
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self._cursor >= len(self._schedule):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
-        self.curr_idx += 1
-
-        if self.major_axis == 1:
-            data = self.nddata[i][j:j + self.batch_size].T
-            label = self.ndlabel[i][j:j + self.batch_size].T
-        else:
-            data = self.nddata[i][j:j + self.batch_size]
-            label = self.ndlabel[i][j:j + self.batch_size]
-
+        bi, off = self._schedule[self._cursor]
+        self._cursor += 1
+        data = self.nddata[bi][off:off + self.batch_size]
+        label = self.ndlabel[bi][off:off + self.batch_size]
+        if self.major_axis == 1:  # time-major
+            data, label = data.T, label.T
         return DataBatch(
             [data], [label], pad=0,
-            bucket_key=self.buckets[i],
+            bucket_key=self.buckets[bi],
             provide_data=[DataDesc(self.data_name, data.shape,
                                    layout=self.layout)],
             provide_label=[DataDesc(self.label_name, label.shape,
