@@ -103,8 +103,11 @@ class TokenEmbedding(_vocab.Vocabulary):
                 self._token_to_idx[token] = len(self._idx_to_token) - 1
                 vecs.append(np.asarray(vec, dtype=np.float32))
         mat = np.zeros((len(self), self._vec_len), dtype=np.float32)
-        mat[0] = init_unknown_vec(self._vec_len)
         n_special = len(self) - len(vecs)
+        # Every non-pretrained row (unknown + all reserved tokens) gets
+        # the unknown initializer, matching the reference's behavior
+        # (embedding.py: loaded_unknown_vec applies to each such row).
+        mat[:n_special] = init_unknown_vec(self._vec_len)
         if vecs:
             mat[n_special:] = np.stack(vecs)
         self._idx_to_vec = mat

@@ -326,6 +326,9 @@ class HybridBlock(Block):
         """Reference: block.py:_call_cached_op → CachedOp::Forward."""
         from jax import tree_util as jtu
 
+        # export() needs the call arity; a hybridized block may never run
+        # the plain forward path that records it.
+        self._num_forward_inputs = len(args)
         flat_args, in_tree = jtu.tree_flatten(
             list(args), is_leaf=lambda x: isinstance(x, NDArray))
         if self._cached_op is None or in_tree != self._cached_in_tree:

@@ -628,38 +628,29 @@ class ImageIter(mxio.DataIter):
                 batch_label[i].shape) if self.label_width > 1 else float(
                 np.asarray(label).ravel()[0])
 
+        # One batch-filling contract for both paths: pull raw records
+        # sequentially, then run the pixel work either inline or fanned
+        # out to the worker team (each future filling its batch slot).
+        pool = self._ensure_pool() if self.preprocess_threads >= 2 else None
+        pending = []
         i = 0
         pad = 0
-        if self.preprocess_threads >= 2:
-            # Sequentially pull raw records, fan the pixel work out to
-            # the worker team, each future filling its batch slot.
-            pool = self._ensure_pool()
-            futures = []
-            while i < self.batch_size:
-                try:
-                    label, raw = self.next_raw()
-                except StopIteration:
-                    if i == 0:
-                        raise
-                    pad = self.batch_size - i
-                    break
-                put_label(i, label)
-                futures.append((i, pool.submit(self._decode_augment, raw)))
-                i += 1
-            for slot, fut in futures:
-                batch_data[slot] = fut.result()  # re-raises worker errors
-        else:
-            while i < self.batch_size:
-                try:
-                    label, raw = self.next_raw()
-                except StopIteration:
-                    if i == 0:
-                        raise
-                    pad = self.batch_size - i
-                    break
+        while i < self.batch_size:
+            try:
+                label, raw = self.next_raw()
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+            put_label(i, label)
+            if pool is not None:
+                pending.append((i, pool.submit(self._decode_augment, raw)))
+            else:
                 batch_data[i] = self._decode_augment(raw)
-                put_label(i, label)
-                i += 1
+            i += 1
+        for slot, fut in pending:
+            batch_data[slot] = fut.result()  # re-raises worker errors
         return mxio.DataBatch(data=[nd_array(batch_data)],
                               label=[nd_array(batch_label)], pad=pad,
                               provide_data=self.provide_data,

@@ -82,7 +82,7 @@ class KVStoreDist(KVStoreLocal):
         self._servers = [_client(addr) for addr in book[1]]
         self._pending_acks = [0] * len(self._servers)
         for conn in self._servers:
-            conn.send(("hello", self._sync))
+            conn.send(("hello", self._sync, self._rank))
         atexit.register(self.close)
         self._start_heartbeat()
 
@@ -170,10 +170,19 @@ class KVStoreDist(KVStoreLocal):
                     raise
         self._servers[server_idx] = conn
         self._pending_acks[server_idx] = 0
-        conn.send(("hello", self._sync))
+        conn.send(("hello", self._sync, self._rank))
+
+    # A long push-only phase must not let un-read acks pile up: past
+    # this many outstanding on one connection the server's socket buffer
+    # could fill with our unread replies, stalling its executor thread
+    # (and with it every worker). 64 is far above any real pipelining
+    # depth (keys in flight per server within one step).
+    _MAX_PENDING_ACKS = 64
 
     def _post(self, server_idx, msg):
         """Fire-and-collect-later send; reply must be a plain ack."""
+        if self._pending_acks[server_idx] >= self._MAX_PENDING_ACKS:
+            self._drain_acks(server_idx)
         try:
             self._servers[server_idx].send(msg)
         except (OSError, EOFError, BrokenPipeError):

@@ -258,14 +258,21 @@ class Scheduler:
 # ---------------------------------------------------------------------------
 
 class _KeyState:
-    __slots__ = ("stored", "accum", "count", "pending_pulls", "pushed_by")
+    __slots__ = ("stored", "pending_pulls", "queues")
 
     def __init__(self, value):
         self.stored = value                     # np.ndarray
-        self.accum = None
-        self.count = 0
         self.pending_pulls = []                 # [(conn, rows or None)]
-        self.pushed_by = set()                  # conns in the open round
+        # Per-worker push queues: a sync round folds exactly ONE push
+        # from every worker, so a worker pipelining its next push before
+        # the round closes (fire-and-forget sends) can never close a
+        # round early or mix gradients across rounds.
+        self.queues = {}                        # conn id -> [grad, ...]
+
+    def in_open_round(self, conn_id):
+        """True when this worker has a push not yet folded into an
+        applied round."""
+        return bool(self.queues.get(conn_id))
 
 
 class KVStoreServer:
@@ -289,6 +296,7 @@ class KVStoreServer:
                                else os.environ.get("DMLC_NUM_WORKER", "1"))
         self.host = host or os.environ.get("DMLC_NODE_HOST", "127.0.0.1")
         self._keys = {}
+        self._conn_rank = {}        # conn id -> worker rank (from hello)
         self._updater = None
         self._opt_blob = None       # pickled optimizer for snapshots
         self._sync_mode = True
@@ -422,6 +430,13 @@ class KVStoreServer:
              else "")
         if cmd == "hello":
             self._sync_mode = bool(msg[1])
+            # Workers announce their rank: sync rounds key on WORKER
+            # identity, not connection identity, so a reconnecting
+            # worker resumes its own queue instead of wedging the round
+            # open with a stale entry (id() of a dead conn can even be
+            # reused by a new one).
+            if len(msg) > 2:
+                self._conn_rank[id(conn)] = msg[2]
         elif cmd == "init":
             self._keys[msg[1]] = _KeyState(np.asarray(msg[2]))
             self._write_snapshot(msg[1])
@@ -438,16 +453,17 @@ class KVStoreServer:
                 self._write_snapshot(key)
                 self._send(conn, ("ok",))
                 return
-            if state.accum is None:
-                state.accum = np.zeros(state.stored.shape, dtype=np.float32)
-            state.accum += grad
-            state.count += 1
-            state.pushed_by.add(id(conn))
-            if state.count == self.num_workers:
-                self._apply(key, state, state.accum)
-                state.accum = None
-                state.count = 0
-                state.pushed_by.clear()
+            wid = self._conn_rank.get(id(conn), id(conn))
+            state.queues.setdefault(wid, []).append(grad)
+            # Round complete: one queued push from num_workers distinct
+            # connections (count the non-empty queues, so a stale entry
+            # from a reconnected worker cannot wedge the round open).
+            ready = [q for q in state.queues.values() if q]
+            if len(ready) == self.num_workers:
+                total = np.zeros(state.stored.shape, dtype=np.float32)
+                for q in ready:
+                    total += q.pop(0)
+                self._apply(key, state, total)
                 self._write_snapshot(key)
                 for (pconn, prows) in state.pending_pulls:
                     self._answer_pull(pconn, state, prows)
@@ -460,8 +476,8 @@ class KVStoreServer:
                 self._send(conn, ("error", "key %r not initialized" % (key,)))
                 return
             rows = np.asarray(msg[2]) if cmd == "pull_rows" else None
-            if self._sync_mode and state.count != 0 and \
-                    id(conn) in state.pushed_by:
+            wid = self._conn_rank.get(id(conn), id(conn))
+            if self._sync_mode and state.in_open_round(wid):
                 # This worker contributed to the OPEN round, so it
                 # expects the value that includes its push: park until
                 # ApplyUpdates flushes it. A puller that has NOT pushed

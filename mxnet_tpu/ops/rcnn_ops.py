@@ -379,6 +379,13 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
         tx = ty = jnp.zeros((R, 1, 1, 1))
     else:
         ncls = trans.shape[1] // 2
+        if od % ncls:
+            # The reference's channels_each_class = od // ncls math
+            # silently assumes divisibility; JAX's clamped gather would
+            # otherwise apply the WRONG class's offsets past the end.
+            raise ValueError(
+                "DeformablePSROIPooling: output_dim (%d) must be a "
+                "multiple of the trans class count (%d)" % (od, ncls))
         cls_of = (jnp.arange(od) // max(od // ncls, 1)).astype(jnp.int32)
         # trans[r, 2*cls+{0,1}, part_h, part_w] (cu:118-125)
         tsel = trans[:, :, part_of][:, :, :, part_of]    # (R, 2ncls, ps, ps)

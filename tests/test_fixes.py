@@ -115,3 +115,45 @@ def test_check_speed_utility():
 
     with pytest.raises(ValueError, match="typ"):
         check_speed(net, N=1, typ="bogus", data=(4, 4))
+
+
+def test_export_hybridized_multi_input(tmp_path):
+    """export() on a hybridized multi-input block that never ran the
+    plain forward path (arity recorded in _call_cached_op too)."""
+    import numpy as np
+
+    class TwoIn(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fc = gluon.nn.Dense(4, in_units=6)
+
+        def hybrid_forward(self, F, a, b):
+            return self.fc(F.concat(a, b, dim=1))
+
+    net = TwoIn()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 3))
+    net(x, x)  # routes through _call_cached_op only
+    sym_f, par_f = net.export(str(tmp_path / "two"))
+    sym = mx.sym.load(sym_f)
+    args = set(sym.list_arguments())
+    assert "data0" in args and "data1" in args, args
+
+
+def test_embedding_special_rows_get_unknown_init(tmp_path):
+    """Reserved-token rows are filled with init_unknown_vec, not zeros."""
+    import numpy as np
+    from mxnet_tpu.contrib import text
+
+    src = tmp_path / "vec.txt"
+    src.write_text("hello 1 2 3\nworld 4 5 6\n")
+    emb = text.embedding.CustomEmbedding(
+        pretrained_file_path=str(src),
+        init_unknown_vec=lambda d: np.full(d, 7.0, dtype=np.float32))
+    vecs = emb.idx_to_vec.asnumpy() if hasattr(emb.idx_to_vec, "asnumpy") \
+        else np.asarray(emb.idx_to_vec)
+    n_special = vecs.shape[0] - 2
+    assert n_special >= 1
+    np.testing.assert_array_equal(vecs[:n_special],
+                                  np.full((n_special, 3), 7.0))
