@@ -146,8 +146,8 @@ def _record_op(op, nd_inputs, arrays, attrs, named=()):
     """Called from the dispatch path while recording: run forward (jitted)
     and push a tape node. RNG keys prepended by prep_inputs are captured
     as constants of the node."""
-    arrays = _reg.prep_inputs(op, arrays)
     attrs_key = _reg._freeze(attrs)
+    arrays = _reg.prep_inputs(op, arrays, attrs_key)
     raw = op.jitted(attrs_key, attrs, named)(*arrays)
     pad = len(arrays) - len(nd_inputs)
     parents = [None] * pad + [_parent_of(x) for x in nd_inputs]

@@ -63,10 +63,21 @@ class CachedOp:
             params = arrays[:cached._num_params]
             inputs = arrays[cached._num_params:]
             with autograd.pause(train_mode=training):
-                with _random.trace_key_scope(rng_key):
+                with _random.trace_key_scope(rng_key) as scope:
                     nd_params = [NDArray(p) for p in params]
                     nd_inputs = [NDArray(x) for x in inputs]
                     out = cached._fn(*(nd_params + nd_inputs))
+            # Trace-time discovery: a graph that drew no keys is
+            # deterministic under these attrs — later dispatches skip
+            # the per-call key derivation (registry.prep_inputs).
+            # Sticky-False: jit retraces per input-shape signature, and
+            # a shape-dependent graph may consume randomness for one
+            # shape but not another — once ANY trace consumed a key,
+            # every dispatch keeps drawing fresh ones.
+            skey = _freeze({"training": training})
+            cached._op.rng_static[skey] = (
+                scope.consumed == 0
+                and cached._op.rng_static.get(skey) is not False)
             if isinstance(out, (list, tuple)):
                 return tuple(o._data if isinstance(o, NDArray) else o for o in out)
             return out._data if isinstance(out, NDArray) else out

@@ -10,10 +10,14 @@ persists across k steps (the standard FlashAttention recurrence on the
 MXU).
 
 `flash_attention` runs the kernel compiled on TPU and in interpret mode
-elsewhere (cpu tests); gradients come from a custom_vjp whose backward
-re-derives through the XLA blockwise formulation
-(`parallel.blockwise_attention`) — same math, so forward speed comes
-from Pallas while autodiff stays exact.
+elsewhere (cpu tests). The backward is flash too (VERDICT r4 #5): a
+custom_vjp saving only (q, k, v, out, logsumexp) — O(T·d) residuals —
+and two Pallas kernels that REGENERATE probability blocks from the
+saved logsumexp (FlashAttention-2 backward): a dK/dV pass iterating
+q-blocks innermost and a dQ pass iterating k-blocks innermost, both
+with the causal block-skip. Peak memory stays O(T·d) where the old
+re-derived `jax.vjp(blockwise_attention)` backward stored O(T²) of
+per-block probabilities across scan steps.
 
 Registered as `_contrib_flash_attention` for `nd`/`sym` access.
 """
@@ -31,7 +35,7 @@ __all__ = ["flash_attention"]
 _NEG = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
             scale, causal, block_q, block_k):
     import jax.experimental.pallas as pl
 
@@ -84,6 +88,19 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finalize():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)[:, None]
                     ).astype(o_ref.dtype)
+        # Per-row logsumexp: the single residual the backward needs to
+        # regenerate any probability block (FlashAttention-2 eq. 5).
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
+
+
+def _block_sizes(tq, tk, block_q, block_k):
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            "sequence lengths (%d, %d) must divide by blocks (%d, %d)"
+            % (tq, tk, block_q, block_k))
+    return block_q, block_k
 
 
 def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -92,30 +109,27 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
-        raise ValueError(
-            "sequence lengths (%d, %d) must divide by blocks (%d, %d)"
-            % (tq, tk, block_q, block_k))
+    block_q, block_k = _block_sizes(tq, tk, block_q, block_k)
     bh = b * h
     q3 = q.reshape(bh, tq, d)
     k3 = k.reshape(bh, tk, d)
     v3 = v.reshape(bh, tk, d)
 
     grid = (bh, tq // block_q, tk // block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, tq), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda b_, i, j: (b_, i, 0)),
+        out_specs=(pl.BlockSpec((1, block_q, d),
+                                lambda b_, i, j: (b_, i, 0)),
+                   pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i))),
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -123,30 +137,181 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(b, h, tq, d)
+    return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
+
+
+def _regen(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, i, j, *,
+           scale, causal, block_q, block_k):
+    """Shared backward recompute: regenerate this (i, j) block's exact
+    probabilities from q/k + saved logsumexp, and form dS (FA2 eqs).
+    Returns (p, ds, q, k, do) in fp32. One copy of the mask convention
+    for both backward passes."""
+    q = q_ref[0].astype(jnp.float32)          # (bq, d)
+    k = k_ref[0].astype(jnp.float32)          # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)        # (bq, d)
+    lse = lse_ref[0]                          # (bq,)
+    delta = dlt_ref[0]                        # (bq,) rowsum(dO*O)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG)
+    p = jnp.exp(s - lse[:, None])             # exact probabilities
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (bq,bk)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds, q, k, do
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, block_q, block_k):
+    """dK/dV pass: grid (bh, k-blocks, q-blocks); the q dimension
+    iterates innermost, accumulating this k-block's gradients in VMEM.
+    Probabilities are REGENERATED from q/k + the saved logsumexp — no
+    O(T²) residual ever exists (the whole point of a flash backward)."""
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)                      # k block (outer)
+    i = pl.program_id(2)                      # q block (inner)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _accumulate():
+        p, ds, q, _, do = _regen(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # p^T do (bk, d)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # ds^T q (bk, d)
+
+    if causal:
+        # q-blocks entirely above the diagonal see zero probability
+        # mass for this k-block: skip them (mirrors the forward's skip).
+        pl.when((i + 1) * block_q - 1 >= j * block_k)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    """dQ pass: grid (bh, q-blocks, k-blocks), k innermost."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)                      # q block (outer)
+    j = pl.program_id(2)                      # k block (inner)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _accumulate():
+        _, ds, _, k, _ = _regen(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, d)
+
+    if causal:
+        pl.when(j * block_k <= (i + 1) * block_q - 1)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, scale, causal, block_q,
+                    block_k, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q, block_k = _block_sizes(tq, tk, block_q, block_k)
+    bh = b * h
+    q3, k3, v3 = (a.reshape(bh, -1, d) for a in (q, k, v))
+    do3 = g.reshape(bh, tq, d)
+    lse2 = lse.reshape(bh, tq)
+    # delta_i = rowsum(dO_i * O_i) — O(T·d), fused by XLA.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, tq)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0))
+    rowq = pl.BlockSpec((1, block_q), lambda b_, j, i: (b_, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=(jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)),
+        grid=(bh, tk // block_k, tq // block_q),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=(pl.BlockSpec((1, block_k, d),
+                                lambda b_, j, i: (b_, j, 0)),
+                   pl.BlockSpec((1, block_k, d),
+                                lambda b_, j, i: (b_, j, 0))),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse2, delta)
+
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0))
+    rowq2 = pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        grid=(bh, tq // block_q, tk // block_k),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b_, i, j: (b_, i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse2, delta)
+
+    return (dq.reshape(q.shape), dk.reshape(k.shape),
+            dv.reshape(v.shape))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                          interpret)
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                         interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                              interpret)
+    # Residuals are O(T·d) (q/k/v/out) + O(T) (lse) — never O(T²).
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    from ..parallel.ring_attention import blockwise_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, block=block_k, causal=causal, scale=scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, scale, causal,
+                           block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

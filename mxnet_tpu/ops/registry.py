@@ -62,6 +62,12 @@ class Operator:
         self.needs_rng = needs_rng
         self.train_aware = train_aware
         self._jit_cache: dict = {}
+        # attrs_key -> True when the trace under those attrs consumed no
+        # randomness (set by CachedOp.pure). Such calls reuse one cached
+        # constant key instead of deriving + uploading a fresh one —
+        # key construction otherwise dominates dispatch overhead
+        # (tools/dispatch_bench.py).
+        self.rng_static: dict = {}
 
     def bound_fn(self, attrs, named=()):
         """Return a positional-arrays closure: trailing `named` inputs are
@@ -132,12 +138,16 @@ def _is_traced(arrays) -> bool:
     return any(isinstance(a, jcore.Tracer) for a in arrays)
 
 
-def prep_inputs(op: Operator, arrays):
+def prep_inputs(op: Operator, arrays, attrs_key=None):
     """Prepend a fresh PRNG key for RNG ops (key is a runtime input, so
-    one executable serves every call with fresh randomness)."""
+    one executable serves every call with fresh randomness). Ops whose
+    trace provably consumed no randomness under these attrs get a cached
+    constant key instead (the executable ignores it anyway)."""
     if op.needs_rng:
         from .. import random as _random
 
+        if attrs_key is not None and op.rng_static.get(attrs_key):
+            return [_random.static_key()] + list(arrays)
         return [_random.next_key()] + list(arrays)
     return arrays
 
@@ -149,8 +159,8 @@ def invoke_raw(op: Operator, arrays, attrs, named=()):
     """Run `op` on raw jax arrays, choosing traced-inline vs jitted path.
     Trailing `named` entries of `arrays` are bound by keyword."""
     global _profiler_mod
-    arrays = prep_inputs(op, arrays)
     attrs_key = _freeze(attrs)
+    arrays = prep_inputs(op, arrays, attrs_key)
     if _is_traced(arrays):
         # Inside an enclosing jit/vjp/vmap trace: inline so the whole
         # surrounding graph compiles as one executable.

@@ -22,10 +22,26 @@ _counter = [0]
 _lock = threading.Lock()
 
 
-def _root_key():
-    import jax
+def _host_key(counter):
+    """Construct key #counter without any eager XLA dispatch.
 
-    return jax.random.PRNGKey(_root_seed)
+    A threefry key is two uint32 words; ``PRNGKey(seed)`` packs them as
+    [hi(seed), lo(seed)]. Deriving stream keys as [counter, seed] is the
+    standard (stream_id, seed) keying — distinct counters give unrelated
+    threefry streams, and counter 0 coincides with ``PRNGKey(seed)`` for
+    32-bit seeds. The eager alternative (PRNGKey + fold_in per call)
+    costs two XLA dispatches ≈1 ms, which dominated CachedOp's call
+    overhead (tools/dispatch_bench.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    # hi(seed) folds into the counter word (XOR is a bijection on
+    # counters, so within-run distinctness is preserved) — 64-bit seeds
+    # differing only above 2^32 still get distinct streams, matching
+    # PRNGKey's [hi, lo] packing for counter 0.
+    return jnp.asarray(np.array(
+        [(counter ^ (_root_seed >> 32)) & 0xFFFFFFFF,
+         _root_seed & 0xFFFFFFFF], np.uint32))
 
 
 def seed(seed_state, ctx="all"):
@@ -51,7 +67,20 @@ def next_key():
     with _lock:
         c = _counter[0]
         _counter[0] += 1
-    return jax.random.fold_in(_root_key(), c)
+    return _host_key(c)
+
+
+_static = [None, None]  # (seed it was built for, key array)
+
+
+def static_key():
+    """A cached constant key for executables that take a key input but
+    provably never consume randomness — skips both the per-call key
+    derivation and its host->device upload."""
+    if _static[1] is None or _static[0] != _root_seed:
+        _static[0] = _root_seed
+        _static[1] = _host_key(0)
+    return _static[1]
 
 
 def advance():
@@ -63,10 +92,13 @@ def advance():
 
 class trace_key_scope:
     """Context manager installing a traced key for ops executed during a
-    jit trace (used by CachedOp / hybridized blocks)."""
+    jit trace (used by CachedOp / hybridized blocks). After exit,
+    ``self.consumed`` says how many keys the trace drew — zero means the
+    compiled executable is deterministic and its key input is dead."""
 
     def __init__(self, key):
         self.key = key
+        self.consumed = 0
 
     def __enter__(self):
         if not hasattr(_state, "trace_keys"):
@@ -75,7 +107,7 @@ class trace_key_scope:
         return self
 
     def __exit__(self, *a):
-        _state.trace_keys.pop()
+        self.consumed = _state.trace_keys.pop()[1]
 
 
 def get_state():

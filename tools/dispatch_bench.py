@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Eager-dispatch overhead microbenchmark: op/s for a 10-op chain,
+eager (op-by-op NDArray dispatch) vs CachedOp (one compiled executable).
+
+SURVEY §7 "hard parts": the reference's engine pushes an op in ~µs while
+an XLA launch costs ~ms, so eager op-by-op can never match the
+reference's imperative throughput — hybridize/CachedOp is the blessed
+path. This records the actual ratio so the claim has a number
+(VERDICT r4 #4b). One JSON line per mode.
+
+Usage: python tools/dispatch_bench.py [--iters 200] [--size 256]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_OPS = 10
+
+
+def chain(nd, x):
+    """A 10-op elementwise/matmul mix shaped like a small layer stack."""
+    y = x
+    y = nd.relu(y)           # 1
+    y = y + 1.0              # 2
+    y = y * 0.5              # 3
+    y = nd.tanh(y)           # 4
+    y = y - 0.1              # 5
+    y = nd.sigmoid(y)        # 6
+    y = y * y                # 7
+    y = nd.exp(-y)           # 8
+    y = y / 2.0              # 9
+    return nd.sum(y)         # 10
+
+
+def bench_eager(mx, x, iters):
+    chain(mx.nd, x).asnumpy()  # warm per-op executable caches
+    t0 = time.monotonic()
+    for _ in range(iters):
+        out = chain(mx.nd, x)
+    out.asnumpy()
+    return time.monotonic() - t0
+
+
+def bench_cached(mx, x, iters):
+    from mxnet_tpu.cached_op import CachedOp
+
+    op = CachedOp(lambda a: chain(mx.nd, a), num_params=0)
+    op(x).asnumpy()  # warm: trace + compile once
+    t0 = time.monotonic()
+    for _ in range(iters):
+        out = op(x)
+    out.asnumpy()
+    return time.monotonic() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--size", type=int, default=256)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    mx.util.pin_platform(os.environ.get("MXNET_DEVICE", "cpu"))
+    import numpy as np
+
+    x = mx.nd.array(np.random.rand(args.size, args.size)
+                    .astype(np.float32))
+    for mode, fn in (("eager", bench_eager), ("cached_op", bench_cached)):
+        dt = fn(mx, x, args.iters)
+        print(json.dumps({
+            "metric": "dispatch_op_per_s", "mode": mode,
+            "value": round(args.iters * N_OPS / dt, 1), "unit": "op/s",
+            "chain_ops": N_OPS, "iters": args.iters,
+            "us_per_op": round(dt / (args.iters * N_OPS) * 1e6, 1)}))
+
+
+if __name__ == "__main__":
+    main()
