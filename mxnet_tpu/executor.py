@@ -166,20 +166,29 @@ class Executor:
                 # Partitioned fragment (mxnet_tpu/subgraph.py): custom
                 # backend fn if provided (e.g. a Pallas kernel), else
                 # evaluate the embedded sub-DAG — always semantics-
-                # preserving.
+                # preserving. Fragments may expose several outputs.
                 in_vals = [value_of(i, i._out_index or 0)
                            for i in node._inputs]
                 fn = getattr(node, "_sub_fn", None)
                 if fn is not None:
-                    val = fn(*in_vals)
+                    vals = fn(*in_vals)
                 else:
                     sub_map = dict(zip(node._sub_arg_names, in_vals))
-                    sub_outs, _ = self._eval_graph(sub_map, {},
-                                                   node._sub_sym.outputs)
-                    val = sub_outs[0]
-                results[(node._uid, 0)] = val
-                results[(node._uid, None)] = val
-                return val
+                    vals, _ = self._eval_graph(sub_map, {},
+                                               node._sub_sym.outputs)
+                if not isinstance(vals, (list, tuple)):
+                    vals = [vals]
+                if len(vals) < node._num_outputs:
+                    raise ValueError(
+                        "_subgraph %r: backend fn returned %d value(s) "
+                        "for a %d-output fragment — a consumer of the "
+                        "missing output would silently read the wrong "
+                        "value" % (node._name, len(vals),
+                                   node._num_outputs))
+                for oi, v in enumerate(vals):
+                    results[(node._uid, oi)] = v
+                results[(node._uid, None)] = vals[0]
+                return results[key] if key in results else vals[0]
             op_name = node._attrs.get("_op_name", node._op)
             op = _registry.get(op_name)
             in_vals = [value_of(i, i._out_index or 0) for i in node._inputs]

@@ -46,8 +46,14 @@ class TrainStep:
         takes ownership of the values and shards them over the mesh).
     loss_fn : callable (pred NDArray, label NDArray) -> per-sample loss.
     optimizer : sgd | nag | signum | signsgd | adam | rmsprop |
-        adagrad | adadelta | ftrl — the SAME update bodies as the
-        Trainer path (ops/optimizer_ops.py), fused into the step.
+        adagrad | adadelta | ftrl | ftml | nadam | dcasgd | sgld |
+        lbsgd — the SAME update bodies as the Trainer path
+        (ops/optimizer_ops.py), fused into the step. One documented
+        deviation: NADAM's momentum-schedule product is per-parameter
+        here (the paper's definition), while the imperative Trainer
+        reproduces the reference's optimizer-instance-shared schedule
+        (optimizer.py:466 — it advances once per parameter per step);
+        the two agree exactly for single-parameter groups.
     optimizer_params : dict — learning_rate, momentum, wd, beta1/2, ...
         learning_rate is a *runtime input* to the executable, so LR
         schedules don't retrace.
@@ -94,6 +100,8 @@ class TrainStep:
         # lamda1, ...), resolved by _make_opt_rule with the same
         # defaults as mxnet_tpu.optimizer's classes
         self._opt_extra = opt_params
+        self._opt_init = None          # custom state init (e.g. DCASGD)
+        self._opt_needs_key = False    # stochastic update (e.g. SGLD)
         self._opt_n_states, self._opt_update = self._make_opt_rule()
         self.num_update = 0
 
@@ -230,10 +238,112 @@ class TrainStep:
                 return w, (z, n)
 
             return 2, ftrl
+        if name == "ftml":
+            check_extra()
+            e = eps(1e-8)
+            fb1 = self.beta1 if "beta1" in self._explicit else 0.6
+
+            def ftml(p, g, s, lr, t):
+                w, d, v, z = oo._ftml_update(
+                    p, g, s[0], s[1], s[2], lr=lr, beta1=fb1, beta2=b2,
+                    epsilon=e, wd=wd, rescale_grad=rs, clip_grad=clip,
+                    t=t)
+                return w, (d, v, z)
+
+            return 3, ftml
+        if name == "nadam":
+            check_extra("schedule_decay")
+            e = eps(1e-8)
+            decay = float(ex.get("schedule_decay", 0.004))
+            # The running schedule product is state starting at 1.0 —
+            # a 0.0 "fresh" sentinel would collide with genuine float32
+            # underflow of the product (~step 130 at default betas) and
+            # reset the bias correction mid-training.
+            self._opt_init = lambda v: (
+                jnp.zeros_like(v, dtype=jnp.float32),
+                jnp.zeros_like(v, dtype=jnp.float32),
+                jnp.ones_like(v, dtype=jnp.float32))
+
+            def nadam(p, g, s, lr, t):
+                mean, var, sched = s
+                g = g * rs + wd * p
+                if clip > 0:
+                    g = jnp.clip(g, -clip, clip)
+                mom_t = b1 * (1.0 - 0.5 * 0.96 ** (t * decay))
+                mom_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * decay))
+                m_sched = sched * mom_t
+                m_sched_next = m_sched * mom_t1
+                mean = b1 * mean + (1.0 - b1) * g
+                var = b2 * var + (1.0 - b2) * g * g
+                g_prime = g / (1.0 - m_sched)
+                m_prime = mean / (1.0 - m_sched_next)
+                v_prime = var / (1.0 - b2 ** t)
+                m_bar = (1.0 - mom_t) * g_prime + mom_t1 * m_prime
+                w = p - lr * m_bar / (jnp.sqrt(v_prime) + e)
+                return w, (mean, var, m_sched)
+            return 3, nadam
+        if name == "dcasgd":
+            check_extra("lamda")
+            lam = float(ex.get("lamda", 0.04))
+            # previous_weight must start AT the weight, not zero — and
+            # as its OWN buffer (asarray would alias the param, and a
+            # donated buffer cannot be donated twice).
+            self._opt_init = lambda v: (
+                jnp.zeros_like(v, dtype=jnp.float32),
+                jnp.array(v, dtype=jnp.float32, copy=True))
+
+            def dcasgd(p, g, s, lr, t):
+                mom_s, prev = s
+                g = g * rs
+                if clip > 0:
+                    g = jnp.clip(g, -clip, clip)
+                delta = -lr * (g + wd * p + lam * g * g * (p - prev))
+                if mom > 0:
+                    mom_s = mom * mom_s + delta
+                    delta = mom_s
+                return p + delta, (mom_s, p.astype(jnp.float32))
+
+            return 2, dcasgd
+        if name == "sgld":
+            check_extra()
+            self._opt_needs_key = True
+
+            def sgld(p, g, s, lr, t, key):
+                g = g * rs
+                if clip > 0:
+                    g = jnp.clip(g, -clip, clip)
+                noise = jax.random.normal(key, p.shape, p.dtype) * \
+                    jnp.sqrt(lr)
+                return p - lr / 2.0 * (g + wd * p) + noise, ()
+
+            return 0, sgld
+        if name == "lbsgd":
+            # LARS-style trust-ratio scaling over SGD (optimizer.py:LBSGD);
+            # warmup knobs are accepted and advisory there too.
+            check_extra("warmup_strategy", "warmup_epochs", "batch_scale",
+                        "updates_per_epoch", "begin_epoch", "num_epochs")
+
+            def lars_lr(p, g, lr):
+                wnorm = jnp.linalg.norm(p)
+                gnorm = jnp.linalg.norm(g) * rs
+                ratio = jnp.minimum(
+                    wnorm / (gnorm + wd * wnorm + 1e-9), 10.0)
+                return jnp.where((wnorm > 0) & (gnorm > 0),
+                                 lr * ratio, lr)
+
+            if mom > 0:
+                return 1, lambda p, g, s, lr, t: _as_pair(
+                    oo._sgd_mom_update(p, g, s[0], lr=lars_lr(p, g, lr),
+                                       momentum=mom, wd=wd,
+                                       rescale_grad=rs,
+                                       clip_gradient=clip))
+            return 0, lambda p, g, s, lr, t: (
+                oo._sgd_update(p, g, lr=lars_lr(p, g, lr), wd=wd,
+                               rescale_grad=rs, clip_gradient=clip), ())
         raise ValueError(
             "TrainStep supports sgd/nag/signum/signsgd/adam/rmsprop/"
-            "adagrad/adadelta/ftrl (got %r); for other optimizers use "
-            "gluon.Trainer" % self.optimizer)
+            "adagrad/adadelta/ftrl/ftml/nadam/dcasgd/sgld/lbsgd (got %r);"
+            " for other optimizers use gluon.Trainer" % self.optimizer)
 
     def _place(self, value, sharding):
         """Lay a host/default-device array out on the (possibly
@@ -273,10 +383,10 @@ class TrainStep:
         # a k-tuple per param (k from the optimizer rule; empty for
         # stateless rules).
         k = self._opt_n_states
-        self._opt_state = {
-            n: tuple(jnp.zeros_like(v, dtype=jnp.float32)
-                     for _ in range(k))
-            for n, v in self._param_vals.items()}
+        init = self._opt_init or (lambda v: tuple(
+            jnp.zeros_like(v, dtype=jnp.float32) for _ in range(k)))
+        self._opt_state = {n: init(v)
+                           for n, v in self._param_vals.items()}
 
         self._shardings = shard_params(
             self.mesh, {n: v.shape for n, v in self._param_vals.items()},
@@ -406,13 +516,24 @@ class TrainStep:
                 return jax.value_and_grad(loss_of, has_aux=True)(
                     pvals, aux_vals, x, y, key)
 
+        needs_key = self._opt_needs_key
+
         def step(pvals, opt_state, aux_vals, x, y, lr, t, key):
             (loss, new_aux), grads = grad_of(pvals, aux_vals, x, y, key)
+            # Stochastic optimizers (SGLD) draw per-param noise from a
+            # stream disjoint from the net's dropout keys.
+            opt_key = jax.random.fold_in(key, 0x7FFFFFFF) if needs_key \
+                else None
             new_p, new_s = {}, {}
-            for name, p in pvals.items():
+            for idx, (name, p) in enumerate(pvals.items()):
                 g = grads[name].astype(jnp.float32)
-                new_p[name], new_s[name] = opt_update(
-                    p, g, opt_state[name], lr, t)
+                if needs_key:
+                    new_p[name], new_s[name] = opt_update(
+                        p, g, opt_state[name], lr, t,
+                        jax.random.fold_in(opt_key, idx))
+                else:
+                    new_p[name], new_s[name] = opt_update(
+                        p, g, opt_state[name], lr, t)
             return new_p, new_s, new_aux, loss
 
         shardings = self._shardings

@@ -37,7 +37,9 @@ _BACKENDS: dict[str, "SubgraphProperty"] = {}
 
 class SubgraphSelector:
     """Decides which nodes join a selection (reference
-    subgraph_property.h:SubgraphSelector). Default: nothing."""
+    subgraph_property.h:SubgraphSelector — SelectInput grows toward
+    producers, SelectOutput toward consumers; the union is an arbitrary
+    connected set). Default: nothing."""
 
     def select(self, node):
         """Start a selection at this node?"""
@@ -47,13 +49,25 @@ class SubgraphSelector:
         """Grow the selection from `node` into its producer?"""
         return False
 
+    def select_output(self, node, output_node):
+        """Grow the selection from `node` into a consumer?"""
+        return False
+
 
 class SubgraphProperty(SubgraphSelector):
     """A backend: selection rules + the replacement executor
     (reference subgraph_property.h:SubgraphProperty). Subclasses
-    override the selector methods and (optionally) `create_fn`."""
+    override the selector methods and (optionally) `create_fn`.
+
+    ``inference_only = True`` additionally admits aux-consuming ops
+    (BatchNorm with its moving stats) into fragments: their aux become
+    plain fragment inputs. Only valid for graphs executed in inference
+    mode — train-mode aux WRITES inside a fragment would be dropped —
+    matching the reference's inference-time properties (TensorRT,
+    quantization)."""
 
     name = None
+    inference_only = False
 
     def create_fn(self, sub_sym, arg_names):
         """Return a jax callable `fn(*arg_values) -> value` replacing
@@ -87,154 +101,260 @@ def _resolve(backend):
 
 def partition(symbol, backend):
     """Replace every maximal matched fragment of `symbol` with a
-    `_subgraph` node (reference build_subgraph pass).
+    `_subgraph` node (reference build_subgraph/partition_graph pass).
 
-    Selection walks each seed node's INPUT chain while
-    `select_input` approves; the fragment must be single-output (the
-    seed). Returns a new Symbol sharing unmatched nodes."""
+    Fragments are CONNECTED SETS: each seed (`select`) grows toward
+    producers (`select_input`) and consumers (`select_output`), exactly
+    the reference SubgraphSelector contract. A fragment may have
+    multiple outputs — every member whose value is consumed outside the
+    fragment (or is a graph output) becomes one output of the
+    `_subgraph` node. Non-convex selections (a path that leaves the
+    fragment and re-enters, which would create a cycle after
+    substitution) are trimmed member-by-member. Returns a new Symbol
+    sharing unmatched nodes."""
     from .symbol import Symbol
 
     prop = _resolve(backend)
     out_syms = symbol.outputs if symbol._op == "_group" else [symbol]
+    nodes = _group_topo(out_syms)     # base nodes only, topo order
+    graph_out_uids = {s._uid for s in out_syms}
 
-    # Count consumers so fragments never swallow a node whose value is
-    # also needed outside the fragment.
-    consumers: dict[int, int] = {}
-    for node in symbol._topo():
+    consumers: dict[int, list] = {}
+    for node in nodes:
         for inp in node._inputs:
-            consumers[inp._uid] = consumers.get(inp._uid, 0) + 1
-    for s in out_syms:
-        consumers[s._uid] = consumers.get(s._uid, 0) + 1
-
-    # Clones keyed by PRODUCER uid: multi-output views share their
-    # producer's uid and differ only in _out_index, so per-view keying
-    # would alias them onto one slot.
-    base_clones: dict[int, Symbol] = {}
-    _UNCHANGED = object()
+            consumers.setdefault(inp._uid, []).append(node)
 
     def _fusable(node):
         """Fragment members must be single-output, stateless ops:
         multi-output views and aux-consuming ops (BatchNorm moving
         stats) are excluded — aux writes inside a fragment would be
         silently dropped."""
-        return (node._num_outputs == 1 and node._out_index is None
-                and not any(i._op is None and i._is_aux
-                            for i in node._inputs))
+        return (node._op is not None and node._op != "_subgraph"
+                and node._num_outputs == 1 and node._out_index is None
+                and (getattr(prop, "inference_only", False)
+                     or not any(i._op is None and i._is_aux
+                                for i in node._inputs)))
 
-    def grow(seed):
-        """Collect the fragment rooted at `seed` (seed + approved
-        producer chain, each interior node consumed only inside)."""
-        members = {seed._uid}
-        order = [seed]
-        frontier = [seed]
+    # -- pass 1: discover fragments ---------------------------------------
+
+    assigned: dict[int, int] = {}     # member uid -> fragment id
+    fragments: list[set] = []
+
+    def make_convex(members):
+        """Drop members until no path exits and re-enters the fragment
+        (a member consuming an external value that itself depends on a
+        member would become a cycle once the fragment is one node)."""
+        while True:
+            dep = {}                  # uid -> depends on a member?
+            bad = None
+            for n in nodes:
+                d = False
+                for i in n._inputs:
+                    if i._op is None:
+                        continue
+                    if i._uid in members or dep.get(i._uid):
+                        d = True
+                if n._uid in members and any(
+                        i._op is not None and i._uid not in members
+                        and dep.get(i._uid) for i in n._inputs):
+                    bad = n._uid
+                dep[n._uid] = d
+            if bad is None:
+                return members
+            members.discard(bad)
+
+    for node in nodes:
+        if node._op is None or node._uid in assigned:
+            continue
+        if not _fusable(node) or not prop.select(node):
+            continue
+        members = {node._uid}
+        frontier = [node]
         while frontier:
-            node = frontier.pop()
-            for inp in node._inputs:
-                if inp._uid in members or inp._op is None:
+            n = frontier.pop()
+            for inp in n._inputs:
+                if (inp._op is None or inp._uid in members
+                        or inp._uid in assigned):
                     continue
-                if not _fusable(inp) or not prop.select_input(node, inp):
+                if _fusable(inp) and prop.select_input(n, inp):
+                    members.add(inp._uid)
+                    frontier.append(inp)
+            for c in consumers.get(n._uid, ()):
+                if c._uid in members or c._uid in assigned:
                     continue
-                if consumers.get(inp._uid, 0) > 1:
-                    continue          # value visible outside the fragment
-                members.add(inp._uid)
-                order.append(inp)
-                frontier.append(inp)
-        return members, order
+                if _fusable(c) and prop.select_output(n, c):
+                    members.add(c._uid)
+                    frontier.append(c)
+        members = make_convex(members)
+        if len(members) > 1:
+            fid = len(fragments)
+            for uid in members:
+                assigned[uid] = fid
+            fragments.append(members)
 
-    def rebuild_base(node):
-        """Clone (or mark unchanged) the producer behind `node`."""
-        hit = base_clones.get(node._uid)
-        if hit is not None:
-            return hit
-        if prop.select(node) and _fusable(node):
-            members, order = grow(node)
-            if len(order) > 1:        # only fuse real fragments
-                new = _make_subgraph_node(node, members)
-                base_clones[node._uid] = new
-                return new
-        new_inputs = [rebuild(i) for i in node._inputs]
-        if all(a is b for a, b in zip(new_inputs, node._inputs)):
-            base_clones[node._uid] = _UNCHANGED
-            return _UNCHANGED
-        clone = Symbol(node._op, attrs=dict(node._attrs),
-                       inputs=new_inputs, name=node._name,
-                       num_outputs=node._num_outputs)
-        # a re-cloned _subgraph node keeps its executor payload
-        for attr in ("_sub_sym", "_sub_arg_names", "_sub_fn"):
-            if hasattr(node, attr):
-                setattr(clone, attr, getattr(node, attr))
-        base_clones[node._uid] = clone
-        return clone
+    if not fragments:
+        return symbol
 
-    def rebuild(node):
-        if node._op is None:
-            return node
-        base = rebuild_base(node)
-        if base is _UNCHANGED:
-            return node
-        if node._out_index is not None:
-            return base[node._out_index]
+    # -- pass 2: rebuild --------------------------------------------------
+
+    _SHARED = object()                # "region untouched, reuse original"
+    clones: dict[int, Symbol] = {}    # non-member base uid -> clone
+    frag_nodes: dict[int, Symbol] = {}
+    frag_out_pos: dict[tuple, int] = {}
+    frag_n_out: dict[int, int] = {}
+
+    def rebuild_view(sym):
+        if sym._op is None:
+            return sym
+        fid = assigned.get(sym._uid)
+        if fid is not None:
+            fnode = build_frag(fid)
+            pos = frag_out_pos[(fid, sym._uid)]
+            if frag_n_out[fid] == 1:
+                return fnode
+            view = fnode[pos]
+            # Views are fresh Symbols sharing the base's uid/inputs; the
+            # executor reads the fragment payload off whichever node it
+            # sees first, so views must carry it too.
+            for attr in ("_sub_sym", "_sub_arg_names", "_sub_fn"):
+                setattr(view, attr, getattr(fnode, attr))
+            return view
+        base = clones.get(sym._uid)
+        if base is None:
+            new_inputs = [rebuild_view(i) for i in sym._inputs]
+            if all(a is b for a, b in zip(new_inputs, sym._inputs)):
+                # Untouched region: a SENTINEL, never the node we
+                # happened to enter through — caching a VIEW here would
+                # hand later base/other-view requests the wrong slot.
+                base = _SHARED
+            else:
+                # Views carry the base's op/attrs/inputs, so a proper
+                # base clone (no out_index) builds from either.
+                base = Symbol(sym._op, attrs=dict(sym._attrs),
+                              inputs=new_inputs, name=sym._name,
+                              num_outputs=sym._num_outputs)
+                for attr in ("_sub_sym", "_sub_arg_names", "_sub_fn"):
+                    if hasattr(sym, attr):
+                        setattr(base, attr, getattr(sym, attr))
+            clones[sym._uid] = base
+        if base is _SHARED:
+            return sym
+        if sym._out_index is not None:
+            return base[sym._out_index]
         return base
 
-    def _make_subgraph_node(seed, members):
-        # External inputs: every edge crossing into the fragment, in
-        # first-use order; they become the _subgraph node's inputs and
-        # the sub-DAG's free variables. Views are distinct values, so
-        # dedup by (uid, out_index).
+    def build_frag(fid):
+        hit = frag_nodes.get(fid)
+        if hit is not None:
+            return hit
+        members = fragments[fid]
+        order = [n for n in nodes if n._uid in members]
+        outputs = [n for n in order
+                   if n._uid in graph_out_uids
+                   or any(c._uid not in members
+                          for c in consumers.get(n._uid, ()))]
+        if not outputs:               # every member internal?! keep seed
+            outputs = [order[-1]]
+
+        # External edges in first-use order -> node inputs + sub vars.
         ext, seen = [], set()
-
-        def scan(node):
-            for inp in node._inputs:
+        for n in order:
+            for inp in n._inputs:
                 if inp._uid in members:
-                    scan(inp)
-                else:
-                    key = (inp._uid, inp._out_index)
-                    if key not in seen:
-                        seen.add(key)
-                        ext.append(inp)
-
-        scan(seed)
-        arg_names = []
-        var_of = {}
+                    continue
+                key = (inp._uid, inp._out_index)
+                if key not in seen:
+                    seen.add(key)
+                    ext.append(inp)
+        arg_names, var_of = [], {}
         for i, e in enumerate(ext):
             nm = e._name if e._op is None else "sub_in%d" % i
             arg_names.append(nm)
             var_of[(e._uid, e._out_index)] = Symbol(None, name=nm)
 
-        # Clone the fragment against the placeholder variables
-        # (members are single-output by _fusable, so a flat uid cache
-        # is safe here).
         inner_cache = {}
 
-        def clone_inner(node):
-            ph = var_of.get((node._uid, node._out_index))
+        def clone_inner(sym):
+            ph = var_of.get((sym._uid, sym._out_index))
             if ph is not None:
                 return ph
-            got = inner_cache.get(node._uid)
+            got = inner_cache.get(sym._uid)
             if got is not None:
                 return got
-            c = Symbol(node._op, attrs=dict(node._attrs),
-                       inputs=[clone_inner(i) for i in node._inputs],
-                       name=node._name, num_outputs=node._num_outputs)
-            inner_cache[node._uid] = c
+            c = Symbol(sym._op, attrs=dict(sym._attrs),
+                       inputs=[clone_inner(i) for i in sym._inputs],
+                       name=sym._name, num_outputs=sym._num_outputs)
+            inner_cache[sym._uid] = c
             return c
 
-        sub_sym = clone_inner(seed)
-        new_inputs = [rebuild(e) for e in ext]
-        node = Symbol("_subgraph",
-                      attrs={"_op_name": "_subgraph",
-                             "__subgraph_backend__": prop.name or
-                             type(prop).__name__},
-                      inputs=new_inputs,
-                      name="%s_subgraph" % (seed._name or "fused"))
-        node._sub_sym = sub_sym
-        node._sub_arg_names = list(arg_names)
-        node._sub_fn = prop.create_fn(sub_sym, list(arg_names))
-        return node
+        sub_outs = [clone_inner(o) for o in outputs]
+        if len(sub_outs) > 1:
+            from . import symbol as _symmod
 
-    new_outs = [rebuild(s) for s in out_syms]
+            sub_sym = _symmod.Group(sub_outs)
+        else:
+            sub_sym = sub_outs[0]
+        new_inputs = [rebuild_view(e) for e in ext]
+        fnode = Symbol("_subgraph",
+                       attrs={"_op_name": "_subgraph",
+                              "__subgraph_backend__": prop.name or
+                              type(prop).__name__},
+                       inputs=new_inputs,
+                       name="%s_subgraph" % (outputs[0]._name or "fused"),
+                       num_outputs=len(outputs))
+        fnode._sub_sym = sub_sym
+        fnode._sub_arg_names = list(arg_names)
+        fnode._sub_fn = prop.create_fn(sub_sym, list(arg_names))
+        for pos, o in enumerate(outputs):
+            frag_out_pos[(fid, o._uid)] = pos
+        frag_n_out[fid] = len(outputs)
+        frag_nodes[fid] = fnode
+        return fnode
+
+    new_outs = [rebuild_view(s) for s in out_syms]
     if symbol._op == "_group":
         from . import symbol as _symmod
 
         return _symmod.Group(new_outs)
     return new_outs[0]
+
+
+def _group_topo(out_syms):
+    """Topological order over the union of several outputs' graphs."""
+    seen = set()
+    order = []
+
+    def visit(node):
+        if node._uid in seen and node._out_index is None:
+            return
+        key = (node._uid, node._out_index)
+        if key in seen:
+            return
+        seen.add(node._uid if node._out_index is None else key)
+        for i in node._inputs:
+            visit(i)
+        order.append(node)
+
+    for s in out_syms:
+        visit(s)
+    # One representative per producer uid. A multi-output node reached
+    # ONLY through views (sl[0], sl[1]) has no out_index-None entry, so
+    # synthesize a base representative from a view — dropping it would
+    # blind the consumer map and convexity check to its edges.
+    from .symbol import Symbol
+
+    rep: dict[int, "Symbol"] = {}
+    uids_in_order = []
+    for n in order:
+        if n._uid not in rep:
+            uids_in_order.append(n._uid)
+        if n._out_index is None:
+            rep[n._uid] = n
+        elif n._uid not in rep:
+            base = Symbol(n._op, n._attrs, n._inputs, n._name,
+                          num_outputs=n._num_outputs, uid=n._uid)
+            for attr in ("_sub_sym", "_sub_arg_names", "_sub_fn"):
+                if hasattr(n, attr):
+                    setattr(base, attr, getattr(n, attr))
+            rep[n._uid] = base
+    return [rep[u] for u in uids_in_order]

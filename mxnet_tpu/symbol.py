@@ -410,10 +410,11 @@ class Symbol:
                 for nm, inp in zip(node._sub_arg_names, node._inputs):
                     if inp._op is None and nm in sub:
                         shapes.setdefault(inp._name, tuple(sub[nm]))
-                s = sub[("out", node._sub_sym._uid,
-                         node._sub_sym._out_index or 0)]
-                shapes[("out", node._uid, 0)] = tuple(s)
-                shapes[("out", node._uid, None)] = tuple(s)
+                for oi, o in enumerate(node._sub_sym.outputs):
+                    s = sub[("out", o._uid, o._out_index or 0)]
+                    shapes[("out", node._uid, oi)] = tuple(s)
+                    if oi == 0:
+                        shapes[("out", node._uid, None)] = tuple(s)
                 continue
             # now eval_shape the node if all inputs known
             in_shapes = []
