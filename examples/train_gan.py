@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""DCGAN-style adversarial training with two optimizers.
+
+Reference: example/gan/CGAN_mnist_R (and the classic gan examples) —
+the two-network/two-Trainer adversarial loop is the API surface this
+driver exercises: generator and discriminator each own a Trainer, the
+discriminator trains on real+fake batches, the generator trains through
+the discriminator's frozen graph.
+
+Synthetic by default (zero-egress): "real" samples are 1×8×8 blob
+images. CI-sized run:
+
+    python examples/train_gan.py --epochs 2 --batches 8
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def build_generator(latent):
+    net = gluon.nn.HybridSequential(prefix="gen_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu", in_units=latent),
+                gluon.nn.Dense(64, activation="relu", in_units=64),
+                gluon.nn.Dense(64, in_units=64),
+                gluon.nn.HybridLambda(lambda F, x: F.tanh(x)))
+    return net
+
+
+def build_discriminator():
+    net = gluon.nn.HybridSequential(prefix="disc_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, in_units=64),
+                gluon.nn.LeakyReLU(0.2),
+                gluon.nn.Dense(32, in_units=64),
+                gluon.nn.LeakyReLU(0.2),
+                gluon.nn.Dense(1, in_units=32))
+    return net
+
+
+def real_batch(rng, batch_size):
+    """Blobby 8x8 images: a bright gaussian bump at a random position."""
+    yy, xx = np.mgrid[0:8, 0:8]
+    cy = rng.uniform(2, 6, size=(batch_size, 1, 1))
+    cx = rng.uniform(2, 6, size=(batch_size, 1, 1))
+    img = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 3.0)
+    return (img * 2 - 1).reshape(batch_size, 64).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batches", type=int, default=16,
+                    help="batches per epoch")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--latent", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s",
+                        stream=sys.stdout, force=True)
+    mx.util.pin_platform(os.environ.get("MXNET_DEVICE", "cpu"))
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    gen = build_generator(args.latent)
+    disc = build_discriminator()
+    gen.initialize(mx.init.Normal(0.05))
+    disc.initialize(mx.init.Normal(0.05))
+    gen.hybridize()
+    disc.hybridize()
+
+    g_tr = gluon.Trainer(gen.collect_params(), "adam",
+                         {"learning_rate": args.lr, "beta1": 0.5})
+    d_tr = gluon.Trainer(disc.collect_params(), "adam",
+                         {"learning_rate": args.lr, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    bs = args.batch_size
+    ones = mx.nd.ones((bs,))
+    zeros = mx.nd.zeros((bs,))
+
+    d_losses = [float("nan")]
+    for epoch in range(args.epochs):
+        d_losses, g_losses = [], []
+        for _ in range(args.batches):
+            real = mx.nd.array(real_batch(rng, bs))
+            z = mx.nd.array(rng.randn(bs, args.latent).astype(np.float32))
+
+            # -- discriminator: real -> 1, fake -> 0 (fake detached by
+            #    recording only disc's forward on generated data)
+            fake = gen(z)
+            with autograd.record():
+                d_loss = (loss_fn(disc(real), ones)
+                          + loss_fn(disc(fake), zeros)).sum()
+            d_loss.backward()
+            d_tr.step(bs)
+
+            # -- generator: fool the discriminator (grads flow through
+            #    disc's graph into gen's params; disc is not stepped)
+            z = mx.nd.array(rng.randn(bs, args.latent).astype(np.float32))
+            with autograd.record():
+                g_loss = loss_fn(disc(gen(z)), ones).sum()
+            g_loss.backward()
+            g_tr.step(bs)
+
+            d_losses.append(float(d_loss.asnumpy()) / bs)
+            g_losses.append(float(g_loss.asnumpy()) / bs)
+        logging.info("epoch %d  d_loss %.4f  g_loss %.4f", epoch,
+                     np.mean(d_losses), np.mean(g_losses))
+
+    # Sanity: the generator's output distribution moved toward the
+    # data's global statistics (blobs have mean ≈ -0.55).
+    z = mx.nd.array(rng.randn(256, args.latent).astype(np.float32))
+    fake_mean = float(gen(z).asnumpy().mean())
+    real_mean = float(real_batch(rng, 256).mean())
+    logging.info("fake mean %.3f vs real mean %.3f", fake_mean, real_mean)
+    if not np.isfinite(np.mean(d_losses)) or not np.isfinite(fake_mean):
+        raise SystemExit("GAN training produced non-finite values")
+
+
+if __name__ == "__main__":
+    main()

@@ -412,6 +412,14 @@ class KVStoreDist(KVStoreLocal):
                 self._call(sidx, ("set_optimizer", blob))
         self._barrier()
 
+    def server_profiler_command(self, sub, arg=None):
+        """Drive every server's profiler over the command channel
+        (reference KVStoreServerProfilerCommand,
+        kvstore_dist_server.h:211-217). Returns the per-server replies
+        — for ``"dumps"`` that is each server's aggregate span table."""
+        return [self._call(s, ("profiler", sub, arg))
+                for s in range(len(self._servers))]
+
     def set_gradient_compression(self, compression_params):
         from .gradient_compression import GradientCompression
 

@@ -504,6 +504,37 @@ class KVStoreServer:
             if self._updater is not None:
                 self._updater.set_states(msg[1])
             self._send(conn, ("ok",))
+        elif cmd == "profiler":
+            # Remote server profiling (reference
+            # KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49,
+            # kvstore_dist_server.h:211-217): workers drive THIS
+            # server's profiler through the command channel. Beyond
+            # parity, "dumps" returns the aggregate table over the wire
+            # instead of only writing a server-local file.
+            from . import profiler as _prof
+
+            sub = msg[1]
+            arg = msg[2] if len(msg) > 2 else None
+            if sub == "set_config":
+                _prof.set_config(**(arg or {}))
+                self._send(conn, ("ok",))
+            elif sub == "set_state":
+                _prof.set_state(arg)
+                self._send(conn, ("ok",))
+            elif sub == "pause":
+                _prof.pause()
+                self._send(conn, ("ok",))
+            elif sub == "resume":
+                _prof.resume()
+                self._send(conn, ("ok",))
+            elif sub == "dump":
+                _prof.dump()
+                self._send(conn, ("ok",))
+            elif sub == "dumps":
+                self._send(conn, ("val", _prof.dumps()))
+            else:
+                self._send(conn, ("error",
+                                  "unknown profiler cmd %r" % (sub,)))
         else:
             self._send(conn, ("error", "unknown command %r" % (cmd,)))
 

@@ -30,6 +30,7 @@ import threading
 
 __all__ = ["set_config", "profiler_set_config", "set_state",
            "profiler_set_state", "pause", "resume", "dump", "dumps",
+           "set_kvstore_handle", "server_dumps",
            "Domain", "Task", "Frame", "Counter", "Marker"]
 
 _state = {
@@ -46,9 +47,33 @@ _counters = {}       # (domain, name) -> value
 _events = []         # (timestamp, kind, name, info)
 
 
-def set_config(**kwargs):
+_kv_handle = [None]
+
+
+def set_kvstore_handle(kv):
+    """Attach a dist kvstore so profile_process='server' calls reach the
+    remote servers (reference profiler.py:set_kvstore_handle — required
+    before server-side profiling commands)."""
+    _kv_handle[0] = kv
+
+
+def _server_cmd(sub, arg=None):
+    kv = _kv_handle[0]
+    if kv is None or not hasattr(kv, "server_profiler_command"):
+        raise RuntimeError(
+            "profile_process='server' needs a dist kvstore: call "
+            "profiler.set_kvstore_handle(kv) with a dist_* store first")
+    return kv.server_profiler_command(sub, arg)
+
+
+def set_config(profile_process="worker", **kwargs):
     """(reference profiler.py:set_config). Accepts the reference's knobs;
-    `filename` names the trace output directory for jax.profiler."""
+    `filename` names the trace output directory for jax.profiler. With
+    ``profile_process='server'`` the config is forwarded to every
+    kvstore server (reference KVStoreServerProfilerCommand kSetConfig)."""
+    if profile_process == "server":
+        _server_cmd("set_config", kwargs)
+        return
     _state["config"].update(kwargs)
 
 
@@ -65,9 +90,13 @@ def _trace_dir():
 
 def set_state(state="stop", profile_process="worker"):
     """'run' starts device tracing + op-span recording; 'stop' ends it
-    (reference profiler.py:set_state)."""
+    (reference profiler.py:set_state). ``profile_process='server'``
+    toggles the profiler on every kvstore server instead."""
     if state not in ("run", "stop"):
         raise ValueError("state must be 'run' or 'stop'")
+    if profile_process == "server":
+        _server_cmd("set_state", state)
+        return
     if state == "run" and not _state["running"]:
         _state["running"] = True
         _state["paused"] = False
@@ -93,10 +122,16 @@ profiler_set_state = set_state
 
 def pause(profile_process="worker"):
     """Suspend op-span recording (reference profiler.py:pause)."""
+    if profile_process == "server":
+        _server_cmd("pause")
+        return
     _state["paused"] = True
 
 
 def resume(profile_process="worker"):
+    if profile_process == "server":
+        _server_cmd("resume")
+        return
     _state["paused"] = False
 
 
@@ -120,8 +155,18 @@ def record_op_span(name, seconds):
 def dump(finished=True, profile_process="worker"):
     """Flush the device trace to disk (reference profiler.py:dump). The
     jax trace is written at stop; dump() stops if still running."""
+    if profile_process == "server":
+        _server_cmd("dump")
+        return
     if _state["running"]:
         set_state("stop")
+
+
+def server_dumps():
+    """Aggregate span tables from every kvstore server (beyond the
+    reference, whose servers only write local files). Returns a list of
+    per-server tables."""
+    return _server_cmd("dumps")
 
 
 def dumps(reset=False, format="table"):

@@ -253,12 +253,50 @@ def run_server_restart(kv):
     log("server restart recovery ok")
 
 
+def run_server_profiling(kv):
+    """Remote server profiling (reference
+    tests/nightly/test_server_profiling.py): rank 0 switches the
+    SERVERS' profiler on through the kvstore command channel, pushes
+    work so the server-side optimizer records op spans, then retrieves
+    each server's aggregate table over the wire."""
+    from mxnet_tpu import profiler
+
+    import shutil
+    import tempfile
+
+    kv.init("p", mx.nd.zeros(SHAPE))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    profiler.set_kvstore_handle(kv)
+    trace_dir = None
+    if kv.rank == 0:
+        trace_dir = tempfile.mkdtemp(prefix="server_profile_")
+        profiler.set_config(profile_process="server",
+                            filename=trace_dir)
+        profiler.set_state("run", profile_process="server")
+    kv._barrier()
+    for _ in range(3):
+        kv.push("p", mx.nd.ones(SHAPE))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull("p", out=out)
+    kv._barrier()
+    if kv.rank == 0:
+        profiler.set_state("stop", profile_process="server")
+        tables = profiler.server_dumps()
+        assert tables and all(isinstance(t, str) for t in tables), tables
+        # the server's optimizer math dispatched through the profiled
+        # path: at least one server recorded sgd update spans
+        assert any("sgd" in t for t in tables), tables[0][-500:]
+        log("server profiling spans ok (%d servers)" % len(tables))
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    kv._barrier()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--kv-type", default="dist_sync")
     parser.add_argument("--mode", default="kvstore",
                         choices=["kvstore", "train", "failure",
-                                 "server_restart"])
+                                 "server_restart", "server_profiling"])
     args = parser.parse_args()
     print("creating kv", file=sys.stderr, flush=True)
     kv = mx.kv.create(args.kv_type)
@@ -271,6 +309,8 @@ def main():
         run_server_restart(kv)
     elif args.mode == "train":
         run_train(kv)
+    elif args.mode == "server_profiling":
+        run_server_profiling(kv)
     elif args.kv_type == "dist_async":
         run_async(kv)
     else:

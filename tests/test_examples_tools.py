@@ -180,3 +180,26 @@ def test_flakiness_checker(tmp_path, monkeypatch):
                 "tests/test_lr_callback.py::test_scheduler_warmup",
                 "-n", "2"], timeout=300)
     assert "0/2 trials failed" in out
+
+
+def test_train_gan_adversarial_loop():
+    """Two-optimizer adversarial loop (reference example/gan)."""
+    out = _run([sys.executable, "examples/train_gan.py",
+                "--epochs", "1", "--batches", "4", "--batch-size", "16"],
+               timeout=300)
+    assert "d_loss" in out and "fake mean" in out
+
+
+def test_train_matrix_factorization_sparse():
+    """Sparse-embedding MF recommender (reference example/recommenders)."""
+    out = _run([sys.executable, "examples/train_matrix_factorization.py",
+                "--epochs", "2", "--samples", "1024",
+                "--num-users", "80", "--num-items", "60"], timeout=300)
+    assert "val_rmse" in out
+
+
+def test_train_rcnn_rpn_proposal_head():
+    """RPN training + Proposal + ROIPooling head (reference example/rcnn)."""
+    out = _run([sys.executable, "examples/train_rcnn.py",
+                "--steps", "6", "--batch-size", "2"], timeout=400)
+    assert "rois" in out and "rpn_loss" in out

@@ -138,3 +138,10 @@ def test_server_restart_recovery(tmp_path):
                 p.wait(timeout=20)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_server_profiling_command():
+    """Workers toggle the SERVERS' profiler through the kvstore command
+    channel and pull back server-side op-span tables (reference
+    KVStoreServerProfilerCommand + tests/nightly/test_server_profiling.py)."""
+    _run("dist_sync", mode="server_profiling")

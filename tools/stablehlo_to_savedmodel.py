@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Convert an `export_stablehlo` artifact to a TensorFlow SavedModel —
+the framework-neutral interchange recipe.
+
+The reference ships ONNX export (python/mxnet/contrib/onnx/mx2onnx) as
+its interchange format. This rebuild's portable artifact is StableHLO
+(`HybridBlock.export_stablehlo` → a self-contained jax.export blob,
+weights embedded); this tool carries it the rest of the way into
+another framework:
+
+    StableHLO artifact --(jax.export.deserialize + jax2tf)--> SavedModel
+    SavedModel --(tf2onnx, any machine that has it)--> model.onnx
+
+Step 2 is one command where tf2onnx is installed (not in this image):
+
+    python -m tf2onnx.convert --saved-model OUT_DIR --output model.onnx
+
+Usage:
+
+    python tools/stablehlo_to_savedmodel.py model.stablehlo out_dir/
+
+The SavedModel serves with plain TensorFlow (no jax, no mxnet_tpu):
+
+    m = tf.saved_model.load(out_dir)
+    y = m.f(tf.constant(x))
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def convert(artifact_path, out_dir):
+    """Load a serialized jax.export artifact and write a SavedModel.
+    Returns the loaded Exported (useful for parity checks)."""
+    import jax
+    from jax import export as jexport
+    from jax.experimental import jax2tf
+    import tensorflow as tf
+
+    with open(artifact_path, "rb") as f:
+        exported = jexport.deserialize(f.read())
+
+    # jax2tf natively understands Exported.call: the StableHLO module
+    # (weights embedded) becomes one XlaCallModule op in the TF graph.
+    # with_gradient=False: export_stablehlo artifacts are inference
+    # graphs (no vjp recorded), matching the reference's predict-only
+    # deployment exports.
+    tf_fn = jax2tf.convert(exported.call, with_gradient=False)
+    module = tf.Module()
+    specs = [tf.TensorSpec(a.shape, a.dtype) for a in exported.in_avals]
+    module.f = tf.function(tf_fn, autograph=False, input_signature=specs)
+    tf.saved_model.save(module, out_dir)
+    return exported
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifact", help="path to a .stablehlo export")
+    ap.add_argument("out_dir", help="SavedModel output directory")
+    args = ap.parse_args()
+    exported = convert(args.artifact, args.out_dir)
+    print("SavedModel written to %s (inputs: %s)"
+          % (args.out_dir, [str(a) for a in exported.in_avals]))
+    print("ONNX last mile: python -m tf2onnx.convert --saved-model %s "
+          "--output model.onnx" % args.out_dir)
+
+
+if __name__ == "__main__":
+    main()
