@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Control-flow benchmark: `foreach` (one compiled scan) vs an unrolled
+per-step RNN.
+
+Reference: benchmark/python/control_flow — the case for the `_foreach`
+op (control_flow.cc:476): a fused sequence loop compiles once and runs
+as ONE executable (`lax.scan` under XLA here), while the unrolled cell
+dispatches T per-step op chains. On TPU the gap is the per-launch
+overhead times sequence length.
+
+    python benchmark/control_flow_bench.py --seq-len 128
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    mx.util.pin_platform(os.environ.get("MXNET_DEVICE", "cpu"))
+
+    T, B, H = args.seq_len, args.batch_size, args.hidden
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(T, B, H).astype(np.float32) * 0.1)
+    h0 = mx.nd.zeros((B, H))
+    w = mx.nd.array((rng.randn(H, H) * 0.05).astype(np.float32))
+
+    def step_fn(inp, state):
+        nh = mx.nd.tanh(mx.nd.dot(inp, w) + state[0])
+        return nh, [nh]
+
+    def foreach_run():
+        out, state = mx.nd.contrib.foreach(step_fn, x, [h0])
+        return state[0]
+
+    def unrolled_run():
+        h = h0
+        for t in range(T):
+            h = mx.nd.tanh(mx.nd.dot(x[t], w) + h)
+        return h
+
+    for name, fn in (("foreach_scan", foreach_run),
+                     ("unrolled", unrolled_run)):
+        fn().asnumpy()             # warm: trace + compile
+        t0 = time.monotonic()
+        for _ in range(args.iters):
+            out = fn()
+        out.asnumpy()
+        dt = time.monotonic() - t0
+        print(json.dumps({
+            "metric": "control_flow_steps_per_s", "mode": name,
+            "value": round(args.iters * T / dt, 1), "unit": "steps/s",
+            "seq_len": T, "ms_per_sequence": round(dt / args.iters * 1e3,
+                                                   2)}))
+
+
+if __name__ == "__main__":
+    main()

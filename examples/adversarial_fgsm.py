@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""FGSM adversarial examples: gradients with respect to the INPUT.
+
+Reference: example/adversary (fast-sign-gradient notebook) — train a
+small classifier, then perturb test inputs by
+``eps * sign(dLoss/dInput)`` and watch accuracy collapse. The API
+surface this driver exercises is input-gradient plumbing:
+``x.attach_grad()`` + ``loss.backward()`` filling a non-parameter
+leaf's ``.grad``.
+
+Synthetic two-class "images" (blob position decides the class).
+
+    python examples/adversarial_fgsm.py --epochs 3
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def make_data(rng, n):
+    """Class 0: bright blob in the left half; class 1: right half."""
+    imgs = rng.rand(n, 1, 12, 12).astype(np.float32) * 0.3
+    labels = rng.randint(0, 2, n)
+    for i, lab in enumerate(labels):
+        col = rng.randint(0, 4) if lab == 0 else rng.randint(8, 12) - 2
+        row = rng.randint(0, 10)
+        imgs[i, 0, row:row + 3, col:col + 3] += 0.7
+    return imgs, labels.astype(np.float32)
+
+
+def accuracy(net, X, Y):
+    pred = net(mx.nd.array(X)).asnumpy().argmax(1)
+    return float((pred == Y).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--train", type=int, default=512)
+    ap.add_argument("--test", type=int, default=256)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--eps", type=float, default=0.25)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s",
+                        stream=sys.stdout, force=True)
+    mx.util.pin_platform(os.environ.get("MXNET_DEVICE", "cpu"))
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    Xtr, Ytr = make_data(rng, args.train)
+    Xte, Yte = make_data(rng, args.test)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 5e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    bs = args.batch_size
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(args.train)
+        total = 0.0
+        for off in range(0, args.train - bs + 1, bs):
+            sel = perm[off:off + bs]
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(Xtr[sel])),
+                               mx.nd.array(Ytr[sel])).sum()
+            loss.backward()
+            tr.step(bs)
+            total += float(loss.asnumpy())
+        logging.info("epoch %d  loss %.4f", epoch, total / args.train)
+
+    clean_acc = accuracy(net, Xte, Yte)
+
+    # FGSM: one gradient step ON THE INPUT, in the loss-ascending
+    # direction (reference adversary notebook's fast sign method).
+    x = mx.nd.array(Xte)
+    x.attach_grad()
+    with autograd.record():
+        loss = loss_fn(net(x), mx.nd.array(Yte)).sum()
+    loss.backward()
+    x_adv = (x + args.eps * mx.nd.sign(x.grad)).clip(0.0, 1.0)
+    adv_acc = accuracy(net, x_adv.asnumpy(), Yte)
+
+    logging.info("clean accuracy %.3f  adversarial accuracy %.3f "
+                 "(eps=%.2f)", clean_acc, adv_acc, args.eps)
+    if clean_acc < 0.85:
+        raise SystemExit("classifier failed to train (%.3f)" % clean_acc)
+    if adv_acc > clean_acc - 0.1:
+        raise SystemExit("FGSM perturbation had no effect "
+                         "(%.3f vs %.3f)" % (adv_acc, clean_acc))
+
+
+if __name__ == "__main__":
+    main()

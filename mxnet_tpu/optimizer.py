@@ -34,12 +34,36 @@ def _is_rsp(grad):
 
 
 def _rsp_rows(grad):
-    """Deduplicated (indices, values) of a row_sparse gradient."""
-    from .ndarray.sparse import _aggregate_rsp
+    """Deduplicated (indices, values) of a row_sparse gradient, padded
+    to a power-of-two row count.
 
-    agg = _aggregate_rsp(grad.data.asnumpy(), grad.indices.asnumpy(),
-                         grad.shape, ctx=grad.context)
-    return agg.indices._data, agg.data._data
+    The padding is the per-shape executable-cache trick (cudnn_algoreg
+    pattern): every batch touches a slightly different number of unique
+    rows, and without bucketing each count compiles fresh
+    gather/scatter executables — measured 100× slower end-to-end on
+    random batches (benchmark/sparse_end2end.py). Pad ids are
+    OUT-OF-RANGE (= num_rows): XLA drops out-of-bounds scatter updates
+    and clamps out-of-bounds gathers, so padded lanes are exact no-ops
+    with no masking arithmetic."""
+    import jax.numpy as jnp
+
+    # Aggregate AND pad entirely on host, then upload once — an
+    # aggregate-on-device detour would round-trip the indices
+    # (upload → download → pad → re-upload) on the hot update path.
+    idx_np = np.asarray(grad.indices.asnumpy(), np.int64)
+    vals_np = np.asarray(grad.data.asnumpy(), np.float32)
+    uniq, inv = np.unique(idx_np, return_inverse=True)
+    out = np.zeros((len(uniq),) + tuple(grad.shape[1:]), np.float32)
+    np.add.at(out, inv, vals_np)
+    n = len(uniq)
+    bucket = 1 << max(n - 1, 0).bit_length() if n else 1
+    if bucket > n:
+        pad = bucket - n
+        uniq = np.concatenate(
+            [uniq, np.full(pad, grad.shape[0], np.int64)])
+        out = np.concatenate(
+            [out, np.zeros((pad,) + out.shape[1:], out.dtype)])
+    return jnp.asarray(uniq), jnp.asarray(out)
 
 
 def _sparse_sgd_update(weight, grad, state, lr, momentum, wd, rescale,

@@ -17,9 +17,9 @@ _ENV = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_DEVICE="cpu",
             XLA_FLAGS="--xla_force_host_platform_device_count=2")
 
 
-def _run(cmd, timeout=240):
-    res = subprocess.run(cmd, capture_output=True, text=True, env=_ENV,
-                         timeout=timeout, cwd=_ROOT)
+def _run(cmd, timeout=240, env=None):
+    res = subprocess.run(cmd, capture_output=True, text=True,
+                         env=env or _ENV, timeout=timeout, cwd=_ROOT)
     assert res.returncode == 0, \
         "cmd %s failed:\n%s\n%s" % (cmd, res.stdout[-2000:],
                                     res.stderr[-2000:])
@@ -203,3 +203,39 @@ def test_train_rcnn_rpn_proposal_head():
     out = _run([sys.executable, "examples/train_rcnn.py",
                 "--steps", "6", "--batch-size", "2"], timeout=400)
     assert "rois" in out and "rpn_loss" in out
+
+
+def test_benchmark_sparse_end2end():
+    """Sparse end-to-end bench runs and reports all three modes
+    (reference benchmark/python/sparse)."""
+    out = _run([sys.executable, "benchmark/sparse_end2end.py",
+                "--features", "2000", "--batches", "3",
+                "--batch-size", "32"], timeout=300)
+    assert out.count("sparse_end2end_samples_per_s") == 3
+    assert "row_sparse" in out and "trainstep_fused" in out
+
+
+def test_benchmark_control_flow():
+    """foreach-vs-unrolled bench runs (reference benchmark/python/
+    control_flow)."""
+    out = _run([sys.executable, "benchmark/control_flow_bench.py",
+                "--seq-len", "16", "--iters", "2"], timeout=300)
+    assert "foreach_scan" in out and "unrolled" in out
+
+
+def test_model_parallel_lstm_group2ctx():
+    """Layer groups placed on distinct devices via group2ctx
+    (reference example/model-parallel)."""
+    env = dict(_ENV, XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    out = _run([sys.executable, "examples/model_parallel_lstm.py",
+                "--steps", "30"], timeout=400, env=env)
+    assert "placement" in out and "nll" in out
+
+
+def test_adversarial_fgsm_input_grads():
+    """Input-gradient API: FGSM collapses accuracy (reference
+    example/adversary)."""
+    out = _run([sys.executable, "examples/adversarial_fgsm.py",
+                "--epochs", "3", "--train", "256", "--test", "128"],
+               timeout=400)
+    assert "adversarial accuracy" in out
