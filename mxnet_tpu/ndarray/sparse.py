@@ -299,12 +299,20 @@ def _gather_rows(rsp, row_ids_np):
     return NDArray(rows, ctx=rsp.context)
 
 
+def _aggregate_rows_np(values_np, indices_np, row_shape):
+    """Host-side core of rsp aggregation: sum duplicate row ids,
+    returning sorted (uniq int64, summed float32 rows). Shared by the
+    kvstore merge path and the eager sparse-optimizer path."""
+    uniq, inv = np.unique(np.asarray(indices_np), return_inverse=True)
+    out = np.zeros((len(uniq),) + tuple(row_shape), np.float32)
+    np.add.at(out, inv, np.asarray(values_np, np.float32))
+    return uniq.astype(np.int64), out
+
+
 def _aggregate_rsp(values_np, indices_np, shape, ctx=None):
     """Sum duplicate row ids into one sorted RowSparseNDArray (the merge
     step of the reference's rsp reduce, comm.h sparse path)."""
-    uniq, inv = np.unique(np.asarray(indices_np), return_inverse=True)
-    out = np.zeros((len(uniq),) + tuple(shape[1:]), np.float32)
-    np.add.at(out, inv, np.asarray(values_np, np.float32))
+    uniq, out = _aggregate_rows_np(values_np, indices_np, shape[1:])
     return RowSparseNDArray(array(out), array(uniq, dtype="int64"),
                             shape, ctx=ctx)
 

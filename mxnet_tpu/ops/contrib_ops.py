@@ -20,10 +20,17 @@ _NEG_INF = -1e30
 
 def _logsumexp3(a, b, c):
     m = jnp.maximum(jnp.maximum(a, b), c)
-    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
-    out = m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe) +
-                           jnp.exp(c - m_safe))
-    return jnp.where(m <= _NEG_INF / 2, _NEG_INF, out)
+    dead = m <= _NEG_INF / 2
+    m_safe = jnp.where(dead, 0.0, m)
+    total = (jnp.exp(a - m_safe) + jnp.exp(b - m_safe)
+             + jnp.exp(c - m_safe))
+    # The dead branch is discarded by the where below, but autodiff
+    # still differentiates it: log(0) has gradient 0/0 = NaN which
+    # poisons the whole backward (the where-grad trap). Make the
+    # discarded branch a well-defined log(1).
+    total = jnp.where(dead, 1.0, total)
+    out = m_safe + jnp.log(total)
+    return jnp.where(dead, _NEG_INF, out)
 
 
 @register("ctc_loss", aliases=("CTCLoss", "_contrib_ctc_loss", "_contrib_CTCLoss"))
@@ -108,10 +115,11 @@ def ctc_loss(pred, label, pred_lengths=None, label_lengths=None,
         jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None],
                             axis=1)[:, 0],
         _NEG_INF)
-    m = jnp.maximum(last_blank, last_label)
-    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
-    ll = m_safe + jnp.log(jnp.exp(last_blank - m_safe) +
-                          jnp.exp(last_label - m_safe))
+    # 2-term logsumexp via the shared 3-term helper (the dead third
+    # term contributes exactly exp(_NEG_INF - m) = 0), so the
+    # where-grad-trap handling lives in ONE place.
+    ll = _logsumexp3(last_blank, last_label,
+                     jnp.full_like(last_blank, _NEG_INF))
     return -ll
 
 

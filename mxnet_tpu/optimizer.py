@@ -47,14 +47,14 @@ def _rsp_rows(grad):
     with no masking arithmetic."""
     import jax.numpy as jnp
 
+    from .ndarray.sparse import _aggregate_rows_np
+
     # Aggregate AND pad entirely on host, then upload once — an
     # aggregate-on-device detour would round-trip the indices
     # (upload → download → pad → re-upload) on the hot update path.
-    idx_np = np.asarray(grad.indices.asnumpy(), np.int64)
-    vals_np = np.asarray(grad.data.asnumpy(), np.float32)
-    uniq, inv = np.unique(idx_np, return_inverse=True)
-    out = np.zeros((len(uniq),) + tuple(grad.shape[1:]), np.float32)
-    np.add.at(out, inv, vals_np)
+    uniq, out = _aggregate_rows_np(grad.data.asnumpy(),
+                                   grad.indices.asnumpy(),
+                                   grad.shape[1:])
     n = len(uniq)
     bucket = 1 << max(n - 1, 0).bit_length() if n else 1
     if bucket > n:

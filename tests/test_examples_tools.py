@@ -239,3 +239,11 @@ def test_adversarial_fgsm_input_grads():
                 "--epochs", "3", "--train", "256", "--test", "128"],
                timeout=400)
     assert "adversarial accuracy" in out
+
+
+def test_train_ctc_ocr():
+    """CTC loss over unaligned sequence labels (reference example/ctc,
+    example/captcha)."""
+    out = _run([sys.executable, "examples/train_ctc_ocr.py",
+                "--steps", "40", "--batch-size", "16"], timeout=400)
+    assert "ctc_loss" in out and "exact-sequence" in out

@@ -157,3 +157,36 @@ def test_embedding_special_rows_get_unknown_init(tmp_path):
     assert n_special >= 1
     np.testing.assert_array_equal(vecs[:n_special],
                                   np.full((n_special, 3), 7.0))
+
+
+def test_ctc_loss_grad_long_sequences_no_nan():
+    """Regression (r5): with realistic T≫S the DP has fully-dead states
+    whose discarded logsumexp branch computed log(0) — autodiff's 0·inf
+    through the `where` poisoned the ENTIRE gradient with NaN (the
+    where-grad trap). Also sanity-check against finite differences."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.contrib_ops import ctc_loss
+
+    rng = np.random.RandomState(0)
+    T, N, C, L = 30, 4, 11, 5
+    pred = jnp.asarray(rng.randn(T, N, C).astype(np.float32))
+    lab = np.full((N, L), -1, np.float32)
+    for i in range(N):
+        n = rng.randint(3, 6)
+        lab[i, :n] = rng.randint(0, 10, n)
+    label = jnp.asarray(lab)
+
+    f = lambda p: ctc_loss(p, label).sum()
+    g = jax.grad(f)(pred)
+    assert np.isfinite(np.asarray(g)).all(), "CTC grad has NaN/inf"
+    assert float(jnp.abs(g).sum()) > 0
+
+    # central finite difference on a few coordinates
+    eps = 1e-2
+    for (t, n, c) in [(3, 2, 5), (0, 0, 10), (29, 3, 1)]:
+        up = float(f(pred.at[t, n, c].add(eps)))
+        dn = float(f(pred.at[t, n, c].add(-eps)))
+        fd = (up - dn) / (2 * eps)
+        np.testing.assert_allclose(fd, float(g[t, n, c]), rtol=0.05,
+                                   atol=5e-3)
