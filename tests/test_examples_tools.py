@@ -247,3 +247,19 @@ def test_train_ctc_ocr():
     out = _run([sys.executable, "examples/train_ctc_ocr.py",
                 "--steps", "40", "--batch-size", "16"], timeout=400)
     assert "ctc_loss" in out and "exact-sequence" in out
+
+
+def test_bi_lstm_sort():
+    """BidirectionalCell seq2seq sorting via Module.fit (reference
+    example/bi-lstm-sort)."""
+    out = _run([sys.executable, "examples/bi_lstm_sort.py",
+                "--steps", "100", "--batch-size", "16"], timeout=400)
+    assert "sorted-position accuracy" in out
+
+
+def test_train_multi_task():
+    """Shared trunk + two heads + joint backward (reference
+    example/multi-task)."""
+    out = _run([sys.executable, "examples/train_multi_task.py",
+                "--epochs", "4"], timeout=400)
+    assert "count-acc" in out and "xpos-mae" in out
