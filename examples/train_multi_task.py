@@ -104,8 +104,9 @@ def main():
         logits, reg = net(mx.nd.array(Xv))
         acc = float((logits.asnumpy().argmax(1) == Ycv).mean())
         mae = float(np.abs(reg.asnumpy()[:, 0] - Yrv).mean())
-        logging.info("epoch %d  loss %.4f  count-acc %.3f  xpos-mae %.4f",
-                     epoch, tot / args.train, acc, mae)
+        n_seen = (args.train // bs) * bs
+        logging.info("epoch %d  loss %.4f  quad-acc %.3f  xpos-mae %.4f",
+                     epoch, tot / n_seen, acc, mae)
 
     if acc < 0.8 or mae > 0.15:
         raise SystemExit("multi-task heads failed to learn "

@@ -50,22 +50,24 @@ def _run_multi(out, steps, num_workers=2):
     assert codes == [0] * num_workers, codes
 
 
+STEPS = 6   # shared by the baseline fixture and every parametrization
+
+
 @pytest.fixture(scope="module")
 def single_proc_baseline(tmp_path_factory):
     """One deterministic 1-process reference run shared by every
     worker-count parametrization."""
     path = str(tmp_path_factory.mktemp("spmd") / "single.npz")
-    _run_single(path, 6)
+    _run_single(path, STEPS)
     return path
 
 
 @pytest.mark.parametrize("num_workers", [2, 4])
-def test_two_process_spmd_matches_single_process(tmp_path, num_workers,
-                                                 single_proc_baseline):
+def test_multi_process_spmd_matches_single_process(tmp_path, num_workers,
+                                                   single_proc_baseline):
     a = single_proc_baseline
     b = str(tmp_path / "multi.npz")
-    steps = 6
-    _run_multi(b, steps, num_workers=num_workers)
+    _run_multi(b, STEPS, num_workers=num_workers)
     za, zb = np.load(a), np.load(b)
     assert sorted(za.files) == sorted(zb.files)
     exact, close = [], []

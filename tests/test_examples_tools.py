@@ -262,4 +262,12 @@ def test_train_multi_task():
     example/multi-task)."""
     out = _run([sys.executable, "examples/train_multi_task.py",
                 "--epochs", "4"], timeout=400)
-    assert "count-acc" in out and "xpos-mae" in out
+    assert "quad-acc" in out and "xpos-mae" in out
+
+
+def test_neural_style_input_optimization():
+    """Gatys-style input optimization with Gram losses (reference
+    example/neural-style)."""
+    out = _run([sys.executable, "examples/neural_style.py",
+                "--steps", "40"], timeout=400)
+    assert "total loss" in out
