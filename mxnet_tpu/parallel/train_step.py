@@ -619,6 +619,85 @@ class TrainStep:
                 self._gather_host(self._opt_state),
                 self._gather_host(self._aux_vals))
 
+    def save_checkpoint(self, path):
+        """Write params + optimizer state + aux + step counter in the
+        framework's binary .params wire format (reference
+        save_checkpoint/save_optimizer_states, model.py:383-413). In a
+        multi-process group every rank gathers but only rank 0 writes;
+        the path works unchanged from 1 host to N.
+
+        Returns the filename written (on every rank)."""
+        from ..ndarray import utils as _nd_utils
+
+        if not self._materialized:
+            raise RuntimeError(
+                "run one step before save_checkpoint so there is state "
+                "to save")
+        pvals, opt, aux = self.state_to_host()
+        seed, counter = _random.get_state()
+        flat = {"step:num_update": np.asarray(self.num_update,
+                                              np.int64),
+                # RNG stream position: resume draws the same keys the
+                # uninterrupted run would (dropout/SGLD bitwise resume).
+                "step:rng": np.asarray([seed, counter], np.int64)}
+        for n, v in pvals.items():
+            flat["arg:" + n] = np.asarray(v)
+        for n, st in opt.items():
+            for i, sv in enumerate(st):
+                flat["opt:%d:%s" % (i, n)] = np.asarray(sv)
+        for n, v in aux.items():
+            flat["aux:" + n] = np.asarray(v)
+        from .dist import rank, barrier
+
+        if rank() == 0:
+            _nd_utils.save(path, {k: NDArray(v)
+                                  for k, v in flat.items()})
+        barrier("train_step_ckpt")
+        return path
+
+    def load_checkpoint(self, path):
+        """Restore a `save_checkpoint` file onto this step's mesh (every
+        rank reads the file — shared filesystems are the pod norm — and
+        places only its addressable shards)."""
+        from ..ndarray import utils as _nd_utils
+
+        if not self._materialized:
+            raise RuntimeError(
+                "run one step (or call after materialization) before "
+                "load_checkpoint so shardings exist")
+        blob = {k: v.asnumpy() if isinstance(v, NDArray) else v
+                for k, v in _nd_utils.load(path).items()}
+
+        def place_as(name, value, like, sharding):
+            # The wire format promotes bf16 to f32 — restore the LIVE
+            # dtype or jit would silently retrace in the wrong one.
+            return self._place(np.asarray(value).astype(like.dtype),
+                               sharding)
+
+        # Build everything BEFORE mutating self: a mismatched file
+        # (wrong net / optimizer family) must raise cleanly, not leave
+        # a half-loaded step.
+        new_p, new_s, new_a = {}, {}, {}
+        for n in self._param_vals:
+            new_p[n] = place_as(n, blob["arg:" + n],
+                                self._param_vals[n], self._shardings[n])
+            new_s[n] = tuple(
+                place_as(n, blob["opt:%d:%s" % (i, n)], s,
+                         self._shardings[n])
+                for i, s in enumerate(self._opt_state[n]))
+        for n in self._aux_vals:
+            new_a[n] = place_as(n, blob["aux:" + n],
+                                self._aux_vals[n], self._repl)
+        num_update = int(np.asarray(blob["step:num_update"]).ravel()[0])
+        rng = blob.get("step:rng")
+
+        self._param_vals, self._opt_state, self._aux_vals = \
+            new_p, new_s, new_a
+        self.num_update = num_update
+        if rng is not None:
+            seed, counter = np.asarray(rng).ravel()
+            _random.set_state(int(seed), int(counter))
+
     def sync_to_net(self):
         """Copy the (possibly sharded) param values back into the net's
         Parameters (gather happens lazily on host read)."""

@@ -112,3 +112,13 @@ class trace_key_scope:
 
 def get_state():
     return (_root_seed, _counter[0])
+
+
+def set_state(seed_state, counter):
+    """Restore an exact (seed, counter) position in the key stream —
+    checkpoint-resume continues the same randomness the uninterrupted
+    run would have drawn."""
+    global _root_seed
+    with _lock:
+        _root_seed = int(seed_state)
+        _counter[0] = int(counter)

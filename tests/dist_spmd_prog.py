@@ -69,6 +69,22 @@ def main():
         loss = step(X[lo:hi], Y[lo:hi])
         losses.append(float(np.asarray(jax.device_get(loss))))
 
+    # Multi-host checkpoint round-trip: rank 0 writes, every rank
+    # restores, and the restored state must equal the live state.
+    ckpt = out_path + ".ckpt"
+    step.save_checkpoint(ckpt)
+    before = step.state_to_host()
+    step.load_checkpoint(ckpt)
+    after = step.state_to_host()
+    for d1, d2 in zip(before, after):
+        for k in d1:
+            v1, v2 = d1[k], d2[k]
+            if isinstance(v1, tuple):
+                assert all(np.array_equal(a, b)
+                           for a, b in zip(v1, v2)), k
+            else:
+                assert np.array_equal(v1, v2), k
+
     params, opt_state, aux = step.state_to_host()
     if dist.rank() == 0:
         flat = {"loss": np.asarray(losses)}
