@@ -101,7 +101,14 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
 @register("Deconvolution", aliases=("deconvolution",))
 def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                    pad=(), adj=(), num_filter=0, num_group=1, no_bias=False,
-                   target_shape=()):
+                   target_shape=(), layout=None):
+    # `layout` accepted for parity with Convolution (gluon's
+    # Conv*DTranspose layers pass it); channel-first is the only
+    # supported public layout, same as the conv path — anything else
+    # must fail loudly, not silently compute NCHW results.
+    if layout not in (None, "NCW", "NCHW", "NCDHW"):
+        raise ValueError("Deconvolution supports channel-first layouts "
+                         "only (got %r)" % (layout,))
     lax = _lax()
     jnp = _jnp()
     ndim = len(kernel) if kernel else weight.ndim - 2

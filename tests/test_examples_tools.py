@@ -271,3 +271,38 @@ def test_neural_style_input_optimization():
     out = _run([sys.executable, "examples/neural_style.py",
                 "--steps", "40"], timeout=400)
     assert "total loss" in out
+
+
+def test_kill_mxnet_finds_dmlc_processes():
+    """tools/kill_mxnet.py sweeps processes carrying the DMLC_ROLE
+    launch contract (reference tools/kill-mxnet.py)."""
+    import time
+
+    marker = "kill_mxnet_test_%d" % os.getpid()
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import time; time.sleep(60)  # " + marker],
+        env=dict(os.environ, DMLC_ROLE="worker"))
+    try:
+        time.sleep(0.3)
+        out = _run([sys.executable, "tools/kill_mxnet.py", "--dry-run",
+                    "--match", marker])
+        assert ("pid %d" % proc.pid) in out and "worker" in out
+        # kill ONLY our marked sleeper — a parallel dist test's
+        # scheduler/server/workers must survive this test.
+        out = _run([sys.executable, "tools/kill_mxnet.py",
+                    "--grace", "1", "--match", marker])
+        assert "terminated" in out
+        time.sleep(0.5)
+        assert proc.poll() is not None, "stray process survived"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_train_autoencoder():
+    """Conv2DTranspose decoder + reconstruction training (reference
+    example/autoencoder)."""
+    out = _run([sys.executable, "examples/train_autoencoder.py",
+                "--epochs", "5"], timeout=400)
+    assert "recon_loss" in out

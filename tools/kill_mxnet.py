@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training processes on this host.
+
+Reference: tools/kill-mxnet.py — after a crashed or interrupted
+distributed run, scheduler/server/worker processes (and their bound
+ports) can linger. This sweeps every live process whose environment
+carries a ``DMLC_ROLE`` (the launch contract tools/launch.py exports)
+and terminates it.
+
+    python tools/kill_mxnet.py            # kill all DMLC-role processes
+    python tools/kill_mxnet.py --dry-run  # just list them
+    python tools/kill_mxnet.py --match train_mnist   # only matching cmdlines
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def _alive(pid):
+    """True when the process exists and is not a zombie."""
+    try:
+        with open("/proc/%d/stat" % pid) as f:
+            return f.read().rsplit(")", 1)[1].split()[0] != "Z"
+    except (OSError, IndexError):
+        return False
+
+
+def dmlc_processes(match=None):
+    """Yield (pid, role, cmdline) for live processes launched under the
+    DMLC env contract (excluding ourselves and our ancestors);
+    ``match`` restricts to cmdlines containing that substring."""
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    while pid > 1:
+        ancestors.add(pid)
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                # ppid is field 4 AFTER the comm, which may itself
+                # contain spaces/parens — split after the last ')'.
+                pid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        if pid in ancestors:
+            continue
+        try:
+            with open("/proc/%d/environ" % pid, "rb") as f:
+                env = f.read()
+        except OSError:
+            continue
+        role = None
+        for var in env.split(b"\0"):
+            if var.startswith(b"DMLC_ROLE="):
+                role = var.split(b"=", 1)[1].decode(errors="replace")
+                break
+        if role is None:
+            continue
+        try:
+            with open("/proc/%d/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    errors="replace").strip()
+        except OSError:
+            cmd = "?"
+        if match and match not in cmd:
+            continue
+        yield pid, role, cmd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dry-run", action="store_true",
+                    help="list matching processes without killing")
+    ap.add_argument("--grace", type=float, default=3.0,
+                    help="seconds between SIGTERM and SIGKILL")
+    ap.add_argument("--match", default=None,
+                    help="only processes whose cmdline contains this")
+    args = ap.parse_args()
+
+    found = list(dmlc_processes(args.match))
+    if not found:
+        print("no DMLC-role processes found")
+        return
+    for pid, role, cmd in found:
+        print("%s[pid %d] %s: %s" % ("(dry-run) " if args.dry_run else "",
+                                     pid, role, cmd[:120]))
+        if not args.dry_run:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+    if args.dry_run:
+        return
+    time.sleep(args.grace)
+    needed_kill = 0
+    for pid, role, _ in found:
+        if not _alive(pid):
+            continue               # SIGTERM worked (or only a zombie left)
+        try:
+            os.kill(pid, signal.SIGKILL)
+            needed_kill += 1
+        except OSError:
+            pass  # raced away
+    print("terminated %d process(es) (%d needed SIGKILL)"
+          % (len(found), needed_kill))
+
+
+if __name__ == "__main__":
+    main()
