@@ -306,3 +306,11 @@ def test_train_autoencoder():
     out = _run([sys.executable, "examples/train_autoencoder.py",
                 "--epochs", "5"], timeout=400)
     assert "recon_loss" in out
+
+
+def test_cnn_text_classification():
+    """Multi-width Conv1D + max-over-time text classifier (reference
+    example/cnn_text_classification)."""
+    out = _run([sys.executable, "examples/cnn_text_classification.py",
+                "--epochs", "3", "--train", "1024"], timeout=400)
+    assert "val-acc" in out
