@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Fully-convolutional segmentation: per-pixel classification with a
+learned upsampling head.
+
+Reference: example/fcn-xs (FCN-8s on VOC) — the API surface this driver
+exercises: an FCN encoder, `Conv2DTranspose` upsampling back to input
+resolution, per-pixel SoftmaxCrossEntropy (label image, not label
+scalar), and mean-IoU evaluation.
+
+Synthetic scenes: background plus two shape classes (filled square,
+filled disc); the label image marks each pixel 0/1/2.
+
+    python examples/train_fcn_segmentation.py --epochs 4
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+SIZE = 24
+NCLS = 3
+
+
+class MiniFCN(gluon.HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.enc = gluon.nn.HybridSequential()
+            self.enc.add(
+                gluon.nn.Conv2D(12, 3, padding=1, activation="relu"),
+                gluon.nn.MaxPool2D(2),                      # 24 -> 12
+                gluon.nn.Conv2D(24, 3, padding=1, activation="relu"),
+                gluon.nn.MaxPool2D(2),                      # 12 -> 6
+                gluon.nn.Conv2D(24, 3, padding=1, activation="relu"))
+            self.up = gluon.nn.HybridSequential()
+            self.up.add(
+                gluon.nn.Conv2DTranspose(16, 4, strides=2, padding=1,
+                                         activation="relu"),  # 6 -> 12
+                gluon.nn.Conv2DTranspose(NCLS, 4, strides=2,
+                                         padding=1))          # 12 -> 24
+
+    def hybrid_forward(self, F, x):
+        return self.up(self.enc(x))        # (N, NCLS, H, W)
+
+
+def make_scene(rng):
+    img = rng.rand(3, SIZE, SIZE).astype(np.float32) * 0.2
+    lab = np.zeros((SIZE, SIZE), np.float32)
+    # one square (class 1)
+    s = rng.randint(5, 9)
+    y, x = rng.randint(0, SIZE - s, 2)
+    img[0, y:y + s, x:x + s] += 0.7
+    lab[y:y + s, x:x + s] = 1
+    # one disc (class 2)
+    cy, cx = rng.randint(6, SIZE - 6, 2)
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE]
+    disc = (yy - cy) ** 2 + (xx - cx) ** 2 <= rng.randint(9, 20)
+    img[1][disc] += 0.7
+    lab[disc] = 2
+    return img, lab
+
+
+def mean_iou(pred, lab):
+    ious = []
+    for c in range(NCLS):
+        inter = ((pred == c) & (lab == c)).sum()
+        union = ((pred == c) | (lab == c)).sum()
+        if union:
+            ious.append(inter / union)
+    return float(np.mean(ious))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--train", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--seed", type=int, default=6)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s",
+                        stream=sys.stdout, force=True)
+    mx.util.pin_platform(os.environ.get("MXNET_DEVICE", "cpu"))
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    data = [make_scene(rng) for _ in range(args.train + 128)]
+    X = np.stack([d[0] for d in data[:args.train]])
+    Y = np.stack([d[1] for d in data[:args.train]])
+    Xv = np.stack([d[0] for d in data[args.train:]])
+    Yv = np.stack([d[1] for d in data[args.train:]])
+
+    net = MiniFCN()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": args.lr})
+    # axis=1: per-pixel class scores in channel dim, label is an image
+    ce = gluon.loss.SoftmaxCrossEntropyLoss(axis=1, sparse_label=True)
+    bs = min(args.batch_size, args.train)
+
+    miou = 0.0
+    for epoch in range(args.epochs):
+        perm = rng.permutation(args.train)
+        tot = 0.0
+        n_seen = 0
+        for off in range(0, args.train - bs + 1, bs):
+            sel = perm[off:off + bs]
+            with autograd.record():
+                loss = ce(net(mx.nd.array(X[sel])),
+                          mx.nd.array(Y[sel])).sum()
+            loss.backward()
+            tr.step(bs)
+            tot += float(loss.asnumpy())
+            n_seen += bs
+        with autograd.pause(train_mode=False):
+            pred = net(mx.nd.array(Xv)).asnumpy().argmax(1)
+        miou = mean_iou(pred, Yv)
+        logging.info("epoch %d  loss %.4f  mean-IoU %.3f", epoch,
+                     tot / n_seen, miou)
+
+    if miou < 0.5:
+        raise SystemExit("segmentation mean-IoU too low: %.3f" % miou)
+
+
+if __name__ == "__main__":
+    main()

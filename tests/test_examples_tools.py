@@ -314,3 +314,11 @@ def test_cnn_text_classification():
     out = _run([sys.executable, "examples/cnn_text_classification.py",
                 "--epochs", "3", "--train", "1024"], timeout=400)
     assert "val-acc" in out
+
+
+def test_train_fcn_segmentation():
+    """Per-pixel classification + Conv2DTranspose upsampling (reference
+    example/fcn-xs)."""
+    out = _run([sys.executable, "examples/train_fcn_segmentation.py",
+                "--epochs", "6"], timeout=500)
+    assert "mean-IoU" in out
