@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""The blessed TPU training path, end to end: RecordIO → parallel
+decode → one fused SPMD executable per step → checkpoint.
+
+This is the driver that shows how this framework actually trains fast
+on TPUs — unlike the reference-parity drivers (Module.fit / Trainer),
+every piece here is the TPU-first design:
+
+1. `im2rec`-style RecordIO dataset (synthetic images packed on the fly),
+2. `ImageRecordIter` with a `preprocess_threads` decode team behind a
+   background prefetcher,
+3. `parallel.TrainStep`: forward + loss + backward + optimizer update
+   compiled into ONE XLA executable over a `Mesh`, bf16 compute with
+   fp32 master weights, buffer donation (in-place updates),
+4. bitwise `save_checkpoint`/`load_checkpoint`.
+
+On a pod: launch one process per host with `tools/launch.py -s 0 ...`
+and add `parallel.dist.initialize()` — the same script spans hosts
+(each worker feeds its `dist.local_slice` of the global batch).
+
+    python examples/train_resnet_trainstep.py --steps 30
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, recordio
+from mxnet_tpu.parallel import TrainStep, make_mesh, dist
+
+
+def pack_dataset(path_prefix, n, size, classes, rng):
+    """Synthetic labeled JPEGs into an indexed RecordIO pair (what
+    tools/im2rec.py produces from an image tree)."""
+    rec, idx = path_prefix + ".rec", path_prefix + ".idx"
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        lab = i % classes
+        img = (rng.rand(size, size, 3) * 60).astype(np.uint8)
+        # class-dependent blob so the task is learnable
+        c = 12 + 8 * lab
+        img[c:c + 10, c:c + 10] += 150
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(lab), i, 0), img, img_fmt=".jpg"))
+    w.close()
+    return rec, idx
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=56)
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--samples", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--dtype", default="bfloat16",
+                    help="compute dtype inside the step (masters fp32)")
+    ap.add_argument("--preprocess-threads", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=12)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s",
+                        stream=sys.stdout, force=True)
+    mx.util.pin_platform(os.environ.get("MXNET_DEVICE", "cpu"))
+    # Multi-host: forms a process group when launched with DMLC_* env
+    # (tools/launch.py -s 0); single-process runs fall straight through.
+    dist.initialize()
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    import jax
+
+    with tempfile.TemporaryDirectory() as td:
+        rec, idx = pack_dataset(os.path.join(td, "ds"), args.samples,
+                                args.image_size, args.classes, rng)
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx,
+            data_shape=(3, 48, 48), batch_size=args.batch_size,
+            shuffle=True, rand_crop=True, rand_mirror=True,
+            mean_r=30.0, mean_g=30.0, mean_b=30.0,
+            preprocess_threads=args.preprocess_threads)
+
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        net = vision.resnet18_v1(classes=args.classes, thumbnail=True)
+        net.initialize(mx.init.Xavier())
+        step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": args.lr,
+                                           "momentum": 0.9, "wd": 1e-4},
+                         mesh=make_mesh(), dtype=args.dtype)
+
+        losses = []
+        seen = 0
+        t0 = None
+        for s in range(args.steps):
+            try:
+                batch = next(it)
+            except StopIteration:
+                it.reset()
+                batch = next(it)
+            lo, hi = dist.local_slice(batch.data[0].shape[0])
+            x = batch.data[0].asnumpy()[lo:hi]
+            y = batch.label[0].asnumpy()[lo:hi]
+            loss = step(x, y)
+            losses.append(float(np.asarray(jax.device_get(loss))))
+            if s == 0:
+                t0 = time.monotonic()   # exclude compile from rate
+            else:
+                seen += batch.data[0].shape[0]
+            if s % 10 == 0 or s == args.steps - 1:
+                logging.info("step %d  loss %.4f", s, losses[-1])
+        rate = seen / (time.monotonic() - t0)
+        ckpt = step.save_checkpoint(os.path.join(td, "final.params"))
+        logging.info("img/s (post-compile) %.1f   checkpoint %s  "
+                     "loss %.4f -> %.4f", rate, os.path.basename(ckpt),
+                     np.mean(losses[:5]), np.mean(losses[-5:]))
+        if not np.mean(losses[-5:]) < np.mean(losses[:5]):
+            raise SystemExit("fused step did not reduce loss")
+
+
+if __name__ == "__main__":
+    main()

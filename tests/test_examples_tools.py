@@ -322,3 +322,13 @@ def test_train_fcn_segmentation():
     out = _run([sys.executable, "examples/train_fcn_segmentation.py",
                 "--epochs", "6"], timeout=500)
     assert "mean-IoU" in out
+
+
+def test_train_resnet_trainstep_blessed_path():
+    """The TPU-blessed pipeline end to end: RecordIO -> decode team ->
+    fused bf16 SPMD TrainStep -> checkpoint."""
+    pytest.importorskip("cv2")
+    out = _run([sys.executable, "examples/train_resnet_trainstep.py",
+                "--steps", "18", "--batch-size", "16",
+                "--samples", "128"], timeout=500)
+    assert "img/s (post-compile)" in out and "checkpoint" in out
