@@ -130,6 +130,75 @@ def _infer_rate(batch, dtype, device):
     return _measure(run_once, lambda tap: float(tap), batch, iters=20)
 
 
+def _serving_rows():
+    """Serving section (mxnet_tpu.serving): single-request latency vs
+    batched throughput at bucket sizes 1/8/32, plus the coalescing rate
+    under concurrent batch-1 load. Rows ride the default device; the
+    measured path includes host batch assembly + one upload per device
+    call — the real serving hot path, not just the executable."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    rng = np.random.RandomState(0)
+    w1 = mx.nd.array(rng.randn(784, 256).astype(np.float32) * 0.05)
+    b1 = mx.nd.zeros((256,))
+    w2 = mx.nd.array(rng.randn(256, 10).astype(np.float32) * 0.05)
+
+    def fwd(w1, b1, w2, x):
+        return mx.nd.dot(mx.nd.relu(mx.nd.dot(x, w1) + b1), w2)
+
+    def _emit(metric, value, unit):
+        print(json.dumps({"metric": metric, "value": value,
+                          "unit": unit}), flush=True)
+
+    # Per-bucket device throughput: a single-bucket server makes every
+    # sequential full-bucket predict() dispatch immediately (rows ==
+    # max_batch) — no max_delay_ms batching-window stall in the number.
+    for b in (1, 8, 32):
+        sb = serving.InferenceServer(fwd, [w1, b1, w2], item_shape=(784,),
+                                     buckets=(b,), max_delay_ms=0)
+        try:
+            xb = rng.rand(b, 784).astype(np.float32)
+            for _ in range(3):
+                sb.predict(xb)                # warm the path
+            t0 = time.perf_counter()
+            n = 30
+            for _ in range(n):
+                sb.predict(xb)
+            _emit("serving_mlp_rows_per_sec_b%d" % b,
+                  round(b * n / (time.perf_counter() - t0), 1), "rows/s")
+        finally:
+            sb.shutdown()
+
+    srv = serving.InferenceServer(fwd, [w1, b1, w2], item_shape=(784,),
+                                  buckets=(1, 8, 32), max_delay_ms=2,
+                                  max_queue=1024)
+    try:
+        # Single-request latency INCLUDES the batching window — the
+        # real cost a lone client pays on a ladder server.
+        lat = []
+        x1 = rng.rand(1, 784).astype(np.float32)
+        for _ in range(50):
+            t0 = time.perf_counter()
+            srv.predict(x1)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        _emit("serving_mlp_single_request_p50_ms",
+              round(lat[len(lat) // 2] * 1e3, 3), "ms")
+        reqs = [rng.rand(1, 784).astype(np.float32) for _ in range(256)]
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(16) as pool:
+            futs = list(pool.map(srv.submit, reqs))
+        for f in futs:
+            f.result()
+        _emit("serving_mlp_coalesced_req_per_sec",
+              round(len(reqs) / (time.perf_counter() - t0), 1), "req/s")
+    finally:
+        srv.shutdown()
+
+
 def _acquire_device(timeout_s=120):
     """Bounded backend acquisition. `jax.devices()` can hang forever
     when the TPU tunnel is down (observed in rounds 3-4); probing from
@@ -190,6 +259,11 @@ def main():
         except Exception:
             print("bench row %s failed:" % metric, file=sys.stderr)
             traceback.print_exc()
+    try:
+        _serving_rows()
+    except Exception:
+        print("bench serving section failed:", file=sys.stderr)
+        traceback.print_exc()
     # Headline LAST (driver parses the final JSON line; BENCH_r01/r02
     # continuity).
     train32 = _train_rate(32, None, dev)

@@ -53,6 +53,7 @@ _LAZY = {
     "executor": ".executor",
     "parallel": ".parallel",
     "profiler": ".profiler",
+    "serving": ".serving",
     "test_utils": ".test_utils",
     "visualization": ".visualization",
     "viz": ".visualization",

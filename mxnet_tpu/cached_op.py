@@ -54,12 +54,21 @@ class CachedOp:
         self._fn = fn
         self._num_params = num_params
         self._flags = flags
+        # Trace-count hook: `pure` runs once per (shape-signature, attrs)
+        # compilation, so num_traces counts executable-cache fills — the
+        # serving warmup contract ("one compile per bucket") is asserted
+        # against it (tests/test_serving.py).
+        self.num_traces = 0
+        self.on_trace = None
         CachedOp._counter[0] += 1
         name = "_cached_op_%d" % CachedOp._counter[0]
 
         cached = self
 
         def pure(rng_key, *arrays, training=False):
+            cached.num_traces += 1
+            if cached.on_trace is not None:
+                cached.on_trace(cached)
             params = arrays[:cached._num_params]
             inputs = arrays[cached._num_params:]
             with autograd.pause(train_mode=training):
@@ -100,4 +109,21 @@ class CachedOp:
             autograd._attach_outputs(result)
             return result
         raw = _reg.invoke_raw(self._op, arrays, attrs)
+        return _wrap_outputs(raw, ctx, out=out)
+
+    def inference(self, *args, out=None):
+        """Eval-mode forward that never records on the autograd tape and
+        never enables train-mode ops (dropout off, BatchNorm running
+        stats) — regardless of any ambient `autograd.record()` scope.
+
+        This is the serving hot path (mxnet_tpu/serving): the reference's
+        ``bind(for_training=False)`` contract at CachedOp granularity.
+        It shares the per-shape executable cache with eval-mode
+        ``__call__`` dispatches."""
+        arrays = [x._data if isinstance(x, NDArray) else x for x in args]
+        ctx = next((x._ctx for x in args if isinstance(x, NDArray)), None)
+
+        from .ops import registry as _reg
+
+        raw = _reg.invoke_raw(self._op, arrays, {"training": False})
         return _wrap_outputs(raw, ctx, out=out)

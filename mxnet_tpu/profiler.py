@@ -170,8 +170,30 @@ def server_dumps():
 
 
 def dumps(reset=False, format="table"):
-    """Aggregate statistics table (reference profiler.py:dumps over
-    aggregate_stats.cc)."""
+    """Aggregate statistics (reference profiler.py:dumps over
+    aggregate_stats.cc). ``format='table'`` renders the human-readable
+    table (reference behavior); ``format='json'`` returns the same data
+    machine-readable — {"trace_dir", "ops": {name: {calls, total_ms,
+    min_ms, max_ms}}, "counters": {"domain::name": value}} — for the
+    bench harness and serving dashboards."""
+    if format not in ("table", "json"):
+        raise ValueError("format must be 'table' or 'json', got %r"
+                         % (format,))
+    if format == "json":
+        import json
+
+        with _lock:
+            payload = {
+                "trace_dir": _trace_dir(),
+                "ops": {name: {"calls": st[0], "total_ms": st[1] * 1e3,
+                               "min_ms": st[2] * 1e3, "max_ms": st[3] * 1e3}
+                        for name, st in _op_stats.items()},
+                "counters": {"%s::%s" % k: v
+                             for k, v in _counters.items()},
+            }
+            if reset:
+                _op_stats.clear()
+            return json.dumps(payload)
     with _lock:
         lines = [
             "Profile Statistics (framework dispatch spans; device timing "
@@ -261,10 +283,15 @@ class Counter:
         return (self.domain.name, self.name)
 
     def set_value(self, value):
-        _counters[self._key()] = value
+        with _lock:
+            _counters[self._key()] = value
 
     def increment(self, delta=1):
-        _counters[self._key()] = _counters.get(self._key(), 0) + delta
+        # Under _lock: serving worker/client threads increment while
+        # dumps() iterates _counters; unlocked read-modify-write would
+        # also lose concurrent increments.
+        with _lock:
+            _counters[self._key()] = _counters.get(self._key(), 0) + delta
 
     def decrement(self, delta=1):
         self.increment(-delta)

@@ -1,0 +1,28 @@
+"""mxnet_tpu.serving — shape-bucketed batching inference over CachedOp
+executables.
+
+The deployment story of the reference (Module ``bind(for_training=
+False)`` + save/load_checkpoint) rebuilt TPU-native: one frozen XLA
+executable per batch-size bucket, precompiled at warmup; a dynamic
+micro-batcher coalescing concurrent requests under a latency deadline;
+bounded-queue admission with deadline shedding; per-bucket stats in
+``mx.profiler.dumps()``.
+
+Lifecycle::
+
+    srv = serving.InferenceServer(fn, params, item_shape=(784,),
+                                  max_batch=32, max_delay_ms=5)
+    fut = srv.submit(x)          # x: (k, *item_shape), k <= max_batch
+    y = fut.result()             # or srv.predict(x)
+    srv.shutdown()               # or use `with serving.InferenceServer(...)`
+"""
+from .admission import AdmissionController, DeadlineExceededError, \
+    QueueFullError
+from .batcher import DynamicBatcher
+from .buckets import BucketPolicy
+from .engine import InferenceServer
+from .metrics import ServingMetrics
+
+__all__ = ["InferenceServer", "BucketPolicy", "DynamicBatcher",
+           "ServingMetrics", "AdmissionController", "QueueFullError",
+           "DeadlineExceededError"]

@@ -1,0 +1,59 @@
+"""Admission control — bounded queueing + deadline-based load shedding.
+
+Under overload an unbounded batching queue converts excess offered load
+into unbounded latency for EVERY request (the queue only drains at
+device speed). Production batchers (Clipper, TF Serving) instead degrade
+gracefully: reject at the door once the queue is full
+(``QueueFullError`` — the client can back off or retry elsewhere), and
+shed queued requests whose deadline already passed (running the model
+for a caller that has given up wastes device time that live requests
+need).
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["QueueFullError", "DeadlineExceededError", "AdmissionController"]
+
+
+class QueueFullError(RuntimeError):
+    """Raised by submit() when the pending queue is at capacity."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """Set on a request's future when it expired before executing."""
+
+
+class AdmissionController:
+    """Policy object consulted by the batcher at enqueue and dispatch.
+
+    Parameters
+    ----------
+    max_queue : int
+        Maximum number of requests waiting (in-flight batches excluded).
+    default_timeout_ms : float, optional
+        Deadline applied to requests that pass no explicit timeout.
+        None means such requests never expire in the queue.
+    """
+
+    def __init__(self, max_queue=128, default_timeout_ms=None):
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1, got %r" % (max_queue,))
+        self.max_queue = max_queue
+        self.default_timeout_ms = default_timeout_ms
+
+    def admit(self, queue_len):
+        """Raise QueueFullError when a new request must be rejected."""
+        if queue_len >= self.max_queue:
+            raise QueueFullError(
+                "serving queue full (%d pending, max_queue=%d)"
+                % (queue_len, self.max_queue))
+
+    def deadline_for(self, timeout_ms=None, now=None):
+        """Absolute monotonic deadline for a request, or None."""
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        if timeout_ms is None:
+            return None
+        return (now if now is not None else time.perf_counter()) \
+            + timeout_ms / 1e3

@@ -1,0 +1,255 @@
+"""InferenceServer — the serving frontend over CachedOp executables.
+
+Execution model: serving is executable-cache management. Each bucket
+size maps to exactly one frozen XLA executable (CachedOp compiles per
+input-shape signature; a loaded checkpoint gets one eval-mode Executor
+per bucket — the reference's bucketed re-bind, reference
+GraphExecutor::Reshape). ``warmup()`` precompiles every bucket so no
+request ever pays compile latency; after warmup the steady state is:
+
+    submit() -> bounded queue -> worker coalesces a bucket ->
+    pad -> ONE device call -> unpad/slice -> resolve futures
+
+Request contract: every request carries an explicit batch dim —
+shape ``(k, *item_shape)``, ``1 <= k <= max_batch``. Results preserve
+it. Inputs are host arrays (numpy or NDArray); the worker assembles the
+padded batch host-side and pays one host->device upload per device call
+(the feed pattern of the training drivers).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..cached_op import CachedOp
+from ..ndarray.ndarray import NDArray
+from .admission import AdmissionController
+from .batcher import DynamicBatcher
+from .buckets import BucketPolicy
+from .metrics import ServingMetrics
+
+__all__ = ["InferenceServer"]
+
+
+class _FnModel:
+    """Any pure ``fn(*params, data)`` wrapped into an eval-mode CachedOp:
+    no tape, no train-mode dropout, one executable per bucket shape."""
+
+    def __init__(self, fn, params):
+        self._params = [p if isinstance(p, NDArray) else nd.array(p)
+                        for p in params]
+        self._cached = CachedOp(fn, num_params=len(self._params))
+
+    def __call__(self, batch):
+        return self._cached.inference(*(self._params + [batch]))
+
+    @property
+    def compile_count(self):
+        return self._cached.num_traces
+
+
+class _CheckpointModel:
+    """A ``model.load_checkpoint`` artifact served through one eval-mode
+    Executor per bucket shape, parameters shared across buckets."""
+
+    def __init__(self, symbol, arg_params, aux_params, data_name="data",
+                 ctx=None):
+        self._symbol = symbol
+        self._arg_params = arg_params
+        self._aux_params = aux_params or {}
+        self._data_name = data_name
+        self._ctx = ctx
+        self._executors = {}  # batch shape -> Executor
+
+    def _executor_for(self, shape):
+        ex = self._executors.get(shape)
+        if ex is None:
+            ex = self._symbol.simple_bind(self._ctx, grad_req="null",
+                                          **{self._data_name: shape})
+            ex.copy_params_from(self._arg_params, self._aux_params,
+                                allow_extra_params=True)
+            self._executors[shape] = ex
+        return ex
+
+    def __call__(self, batch):
+        ex = self._executor_for(tuple(batch.shape))
+        outs = ex.forward(is_train=False, **{self._data_name: batch})
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    @property
+    def compile_count(self):
+        # one jitted eval executable per bucket executor, built on its
+        # first forward (Executor._fwd_cache); snapshot — the worker may
+        # be inserting a cold bucket's executor concurrently
+        return sum(1 for ex in list(self._executors.values())
+                   if ex._fwd_cache)
+
+
+class InferenceServer:
+    """Shape-bucketed batching inference server.
+
+    Parameters
+    ----------
+    fn : callable(*params, data), optional
+        Pure eval-time forward over NDArrays. Mutually exclusive with
+        `model` (see `from_checkpoint`).
+    params : sequence of NDArray/ndarray
+        Leading arguments bound to `fn`.
+    item_shape : tuple
+        Per-example shape, WITHOUT the batch dim. Declares the bucket
+        executables' signatures for warmup.
+    dtype : input dtype (default float32).
+    max_batch, buckets : bucket ladder (BucketPolicy).
+    max_delay_ms : float
+        Batching window — longest a request waits for co-batching.
+    max_queue : int
+        Bounded-queue admission limit (QueueFullError beyond it).
+    timeout_ms : float, optional
+        Default per-request deadline; expired queued requests are shed
+        with DeadlineExceededError.
+    warmup : precompile every bucket at construction (default True).
+    start : start the worker thread at construction (default True).
+    """
+
+    def __init__(self, fn=None, params=(), *, item_shape, dtype="float32",
+                 max_batch=32, buckets=None, max_delay_ms=5.0,
+                 max_queue=128, timeout_ms=None, ctx=None, metrics=None,
+                 model=None, warmup=True, start=True):
+        if (fn is None) == (model is None):
+            raise ValueError("pass exactly one of fn= or model=")
+        self._model = model if model is not None else _FnModel(fn, params)
+        self._item_shape = tuple(item_shape)
+        self._dtype = np.dtype(dtype)
+        self._ctx = ctx
+        self.policy = BucketPolicy(max_batch=max_batch, buckets=buckets)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._warmed = set()
+        # Serializes device calls: warmup() on an already-started server
+        # must not race the worker through the model's executor cache.
+        self._model_lock = threading.Lock()
+        self._batcher = DynamicBatcher(
+            self._run_batch, self.policy,
+            AdmissionController(max_queue=max_queue,
+                                default_timeout_ms=timeout_ms),
+            self.metrics, max_delay_ms=max_delay_ms)
+        if warmup:
+            self.warmup()
+        if start:
+            self._batcher.start()
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, *, item_shape, data_name="data",
+                        **kwargs):
+        """Serve a ``model.save_checkpoint`` artifact (`prefix-symbol.json`
+        + `prefix-%04d.params`)."""
+        from .. import model as _model
+
+        symbol, arg_params, aux_params = _model.load_checkpoint(prefix,
+                                                                epoch)
+        backend = _CheckpointModel(symbol, arg_params, aux_params,
+                                   data_name=data_name,
+                                   ctx=kwargs.get("ctx"))
+        return cls(model=backend, item_shape=item_shape, **kwargs)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def warmup(self, buckets=None):
+        """Compile one executable per bucket by running a dummy batch of
+        each bucket shape. Idempotent: already-warmed buckets are
+        skipped, and re-running a warmed shape is an executable-cache
+        hit anyway."""
+        for b in (buckets if buckets is not None else self.policy.buckets):
+            with self._model_lock:
+                if b in self._warmed:
+                    continue
+                batch = nd.array(np.zeros((b,) + self._item_shape,
+                                          self._dtype), ctx=self._ctx)
+                out = self._model(batch)
+                for o in (out if isinstance(out, tuple) else (out,)):
+                    o.wait_to_read()
+                self._warmed.add(b)
+        return self
+
+    def start(self):
+        self._batcher.start()
+        return self
+
+    def pause(self):
+        """Suspend dispatch (submits still queue) — drain control."""
+        self._batcher.pause()
+        return self
+
+    def resume(self):
+        self._batcher.resume()
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        self._batcher.shutdown(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # -- request path ---------------------------------------------------------
+
+    def submit(self, data, timeout_ms=None):
+        """Enqueue one request; returns a `concurrent.futures.Future`
+        yielding the output rows for this request (batch dim preserved;
+        multi-output models yield a tuple)."""
+        # Snapshot the request (asnumpy is already a fresh host copy;
+        # np.array always copies): the worker reads it up to a delay
+        # window later, so it must not alias a buffer the caller reuses.
+        arr = data.asnumpy() if isinstance(data, NDArray) \
+            else np.array(data, dtype=self._dtype)
+        if tuple(arr.shape[1:]) != self._item_shape:
+            raise ValueError(
+                "request shape %r does not match (k,) + item_shape %r"
+                % (tuple(arr.shape), self._item_shape))
+        rows = int(arr.shape[0])
+        if not 1 <= rows <= self.policy.max_batch:
+            raise ValueError("request rows must be in [1, %d], got %d"
+                             % (self.policy.max_batch, rows))
+        return self._batcher.submit(arr.astype(self._dtype, copy=False),
+                                    rows, timeout_ms=timeout_ms)
+
+    def predict(self, data, timeout_ms=None):
+        """Synchronous submit: block until the batched result arrives."""
+        return self.submit(data, timeout_ms=timeout_ms).result()
+
+    @property
+    def compile_count(self):
+        return self._model.compile_count
+
+    def stats(self):
+        return self.metrics.snapshot()
+
+    # -- worker side ----------------------------------------------------------
+
+    def _run_batch(self, requests, bucket):
+        """Assemble+pad the bucket batch, ONE device call, unpad per
+        request. Runs on the batcher worker thread."""
+        t0 = time.perf_counter()
+        batch = np.zeros((bucket,) + self._item_shape, self._dtype)
+        spans, off = [], 0
+        for req in requests:
+            batch[off:off + req.rows] = req.data
+            spans.append((req, off, off + req.rows))
+            off += req.rows
+        with self._model_lock:
+            out = self._model(nd.array(batch, ctx=self._ctx))
+            outs = out if isinstance(out, tuple) else (out,)
+            for o in outs:
+                o.wait_to_read()  # latency truth under async dispatch
+        self.metrics.record_batch(bucket, off, len(requests),
+                                  time.perf_counter() - t0)
+        done = time.perf_counter()
+        for req, i0, i1 in spans:
+            sliced = tuple(o[i0:i1] for o in outs)
+            self.metrics.record_request_latency(bucket,
+                                                done - req.t_submit)
+            req.future.set_result(sliced if len(sliced) > 1 else sliced[0])

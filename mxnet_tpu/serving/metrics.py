@@ -1,0 +1,120 @@
+"""Per-bucket serving statistics, exported through mx.profiler.
+
+Two sinks, same events:
+
+1. ``profiler.record_op_span("serving::bucket_<N>", dt)`` per device
+   batch and a ``serving`` profiler Domain for counters — so
+   ``profiler.dumps()`` (table or json) shows serving stats alongside op
+   dispatch stats with no extra wiring. Spans are recorded
+   unconditionally, like profiler Counters: serving stats are cheap
+   aggregates, not traces, and operators read them while the device
+   profiler is off.
+2. A local snapshot() with the derived numbers the profiler table
+   cannot express — mean occupancy (padding efficiency) and p50/p99
+   request latency from a bounded reservoir.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+__all__ = ["ServingMetrics"]
+
+_RESERVOIR = 2048  # per-bucket latency samples kept for percentiles
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class ServingMetrics:
+    def __init__(self, domain="serving"):
+        from .. import profiler
+
+        self._profiler = profiler
+        self._domain = profiler.Domain(domain)
+        self._lock = threading.Lock()
+        self._buckets = {}   # bucket -> dict
+        self._shed = {}      # reason -> count
+        self._counters = {}  # name -> profiler.Counter
+
+    def _counter(self, name):
+        # Get-or-create under the lock: a creation race (two threads
+        # shedding at once) would re-run new_counter(name, 0) and zero
+        # a count the other thread already recorded.
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                # No 0-seed: a second server in the same process must
+                # not wipe the shared serving-domain counts; increment
+                # starts absent keys from 0 anyway.
+                c = self._domain.new_counter(name)
+                self._counters[name] = c
+        return c
+
+    def _bucket(self, bucket):
+        st = self._buckets.get(bucket)
+        if st is None:
+            st = {"requests": 0, "batches": 0, "rows": 0,
+                  "latencies": deque(maxlen=_RESERVOIR)}
+            self._buckets[bucket] = st
+        return st
+
+    # -- recording ------------------------------------------------------------
+
+    def record_batch(self, bucket, rows, n_requests, seconds):
+        """One device call: `n_requests` coalesced into `rows` real rows,
+        padded up to `bucket`."""
+        self._profiler.record_op_span("serving::bucket_%d" % bucket,
+                                      seconds)
+        with self._lock:
+            st = self._bucket(bucket)
+            st["batches"] += 1
+            st["requests"] += n_requests
+            st["rows"] += rows
+        self._counter("requests").increment(n_requests)
+        self._counter("batches").increment(1)
+
+    def record_request_latency(self, bucket, seconds):
+        """submit()-to-result latency of one request (queueing included)."""
+        with self._lock:
+            self._bucket(bucket)["latencies"].append(seconds)
+
+    def record_shed(self, reason):
+        """A request was rejected (`queue_full`) or expired (`deadline`)."""
+        with self._lock:
+            self._shed[reason] = self._shed.get(reason, 0) + 1
+        self._counter("shed_" + reason).increment(1)
+
+    # -- reading --------------------------------------------------------------
+
+    def snapshot(self):
+        """Machine-readable stats: per-bucket occupancy + latency
+        percentiles, plus shed counts."""
+        with self._lock:
+            out = {"buckets": {}, "shed": dict(self._shed)}
+            for bucket in sorted(self._buckets):
+                st = self._buckets[bucket]
+                lats = sorted(st["latencies"])
+                out["buckets"][bucket] = {
+                    "requests": st["requests"],
+                    "batches": st["batches"],
+                    "mean_occupancy": (st["rows"] / (st["batches"] * bucket)
+                                       if st["batches"] else 0.0),
+                    "p50_ms": _percentile(lats, 0.50) * 1e3,
+                    "p99_ms": _percentile(lats, 0.99) * 1e3,
+                }
+            return out
+
+    @property
+    def total_batches(self):
+        with self._lock:
+            return sum(st["batches"] for st in self._buckets.values())
+
+    @property
+    def total_shed(self):
+        with self._lock:
+            return sum(self._shed.values())

@@ -159,6 +159,30 @@ def test_embedding_special_rows_get_unknown_init(tmp_path):
                                   np.full((n_special, 3), 7.0))
 
 
+def test_cached_op_retrace_only_on_new_signature():
+    """Executable-cache contract behind serving warmup (PR 1): exactly
+    one trace per (shape, train-mode) signature, repeats are cache hits,
+    and the on_trace hook observes every compile."""
+    from mxnet_tpu.cached_op import CachedOp
+
+    traces = []
+    cop = CachedOp(lambda x: x * 3.0)
+    cop.on_trace = lambda c: traces.append(c.num_traces)
+    for _ in range(4):
+        cop(mx.nd.ones((2, 3)))
+    assert cop.num_traces == 1
+    cop(mx.nd.ones((5, 3)))            # new shape -> one new executable
+    assert cop.num_traces == 2
+    x = mx.nd.ones((2, 3))
+    x.attach_grad()
+    with mx.autograd.record():         # train-mode trace is distinct
+        cop(x)
+    assert cop.num_traces == 3
+    cop.inference(mx.nd.ones((2, 3)))  # eval cache hit, no retrace
+    assert cop.num_traces == 3
+    assert traces == [1, 2, 3]
+
+
 def test_ctc_loss_grad_long_sequences_no_nan():
     """Regression (r5): with realistic T≫S the DP has fully-dead states
     whose discarded logsumexp branch computed log(0) — autodiff's 0·inf

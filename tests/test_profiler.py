@@ -57,6 +57,37 @@ def test_profiler_domains_counters():
     assert "test_domain::ops_done" in stats
 
 
+def test_profiler_dumps_json_format():
+    """dumps(format='json') returns the aggregate stats machine-readable
+    (the bench harness and serving dashboards consume this)."""
+    import json
+
+    import pytest
+
+    profiler.dumps(reset=True)
+    dom = profiler.Domain("jsontest")
+    dom.new_counter("widgets", 7)
+    profiler.set_state("run")
+    x = mx.nd.ones((8, 8)).tanh()
+    x.wait_to_read()
+    profiler.set_state("stop")
+
+    payload = json.loads(profiler.dumps(format="json"))
+    assert set(payload) == {"trace_dir", "ops", "counters"}
+    tanh_keys = [k for k in payload["ops"] if "tanh" in k]
+    assert tanh_keys, sorted(payload["ops"])
+    st = payload["ops"][tanh_keys[0]]
+    assert st["calls"] >= 1
+    assert 0 <= st["min_ms"] <= st["max_ms"] <= st["total_ms"] + 1e-9
+    assert payload["counters"]["jsontest::widgets"] == 7
+
+    # reset through the json path clears op stats like the table path
+    json.loads(profiler.dumps(format="json", reset=True))
+    assert not json.loads(profiler.dumps(format="json"))["ops"]
+    with pytest.raises(ValueError):
+        profiler.dumps(format="xml")
+
+
 def test_monitor_collects_stats():
     from mxnet_tpu.monitor import Monitor
 

@@ -1,0 +1,404 @@
+"""mxnet_tpu.serving — shape-bucketed batching inference server
+(ISSUE 1 tentpole). Tiny models + max_delay_ms <= 20 keep every test
+CI-sized; every server is closed in a finally/with so no worker thread
+outlives its test."""
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, serving
+from mxnet_tpu.cached_op import CachedOp
+from mxnet_tpu.serving import (BucketPolicy, DeadlineExceededError,
+                               InferenceServer, QueueFullError)
+
+_W = None
+
+
+def _weight():
+    global _W
+    if _W is None:
+        _W = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    return _W
+
+
+def _dot_fn(w, x):
+    return mx.nd.dot(x, w)
+
+
+def _server(**kw):
+    kw.setdefault("item_shape", (4,))
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_delay_ms", 10)
+    return InferenceServer(_dot_fn, [_weight()], **kw)
+
+
+# -- bucket policy ----------------------------------------------------------
+
+def test_bucket_policy_powers_of_two():
+    p = BucketPolicy(max_batch=32)
+    assert p.buckets == (1, 2, 4, 8, 16, 32)
+    assert p.bucket_for(1) == 1
+    assert p.bucket_for(3) == 4
+    assert p.bucket_for(32) == 32
+    assert p.pad_rows(5) == 3
+    with pytest.raises(ValueError):
+        p.bucket_for(33)
+    with pytest.raises(ValueError):
+        p.bucket_for(0)
+
+
+def test_bucket_policy_explicit_ladder_and_uneven_top():
+    p = BucketPolicy(buckets=(8, 1, 32))
+    assert p.buckets == (1, 8, 32) and p.max_batch == 32
+    assert p.bucket_for(2) == 8
+    # non-power-of-two max_batch still tops the default ladder exactly
+    q = BucketPolicy(max_batch=12)
+    assert q.buckets == (1, 2, 4, 8, 12)
+
+
+# -- acceptance (a): coalescing ---------------------------------------------
+
+def test_concurrent_submits_coalesce_into_min_device_calls():
+    """N concurrent batch-1 submits execute in <= ceil(N/max_batch)
+    device calls, with correct per-request results."""
+    srv = _server(warmup=True)
+    try:
+        base = srv.metrics.total_batches
+        srv.pause()
+        xs = [np.random.rand(1, 4).astype(np.float32) for _ in range(17)]
+        with ThreadPoolExecutor(8) as pool:
+            futs = list(pool.map(srv.submit, xs))
+        srv.resume()
+        outs = [f.result(timeout=30) for f in futs]
+        w = _weight().asnumpy()
+        for x, y in zip(xs, outs):
+            assert y.shape == (1, 3)
+            np.testing.assert_allclose(y.asnumpy(), x @ w, rtol=1e-5)
+        calls = srv.metrics.total_batches - base
+        assert calls <= -(-17 // 8), "17 singles took %d device calls" % calls
+    finally:
+        srv.shutdown()
+
+
+# -- acceptance (b): one compile per bucket ---------------------------------
+
+def test_one_compile_per_bucket_and_warmup_idempotent():
+    srv = _server(warmup=True, start=False)
+    try:
+        assert srv.compile_count == len(srv.policy.buckets)  # 1,2,4,8
+        srv.warmup()  # second warmup: no new executables
+        assert srv.compile_count == len(srv.policy.buckets)
+        srv.start()
+        # warmed-bucket traffic never compiles
+        srv.pause()
+        futs = [srv.submit(np.ones((1, 4), np.float32)) for _ in range(9)]
+        srv.resume()
+        for f in futs:
+            f.result(timeout=30)
+        assert srv.compile_count == len(srv.policy.buckets)
+    finally:
+        srv.shutdown()
+
+
+def test_cached_op_executable_cache_many_signatures():
+    """The underlying contract: CachedOp compiles once per shape
+    signature, and repeats are pure cache hits."""
+    cop = CachedOp(lambda x: x * 2.0 + 1.0)
+    shapes = [(1, 4), (2, 4), (4, 4), (8, 4), (3, 5)]
+    for s in shapes * 3:
+        y = cop.inference(mx.nd.ones(s))
+        assert y.shape == s
+    assert cop.num_traces == len(shapes)
+
+
+def test_inference_call_skips_tape_and_train_mode():
+    """CachedOp.inference never records on the tape even inside
+    record(), and runs the eval-mode trace (dropout disabled)."""
+    cop = CachedOp(lambda x: mx.nd.Dropout(x, p=0.5) * 1.0)
+    x = mx.nd.ones((4, 4))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = cop.inference(x)
+    assert y._ag_node is None, "inference() recorded on the tape"
+    # eval-mode dropout is identity
+    np.testing.assert_allclose(y.asnumpy(), np.ones((4, 4)), rtol=1e-6)
+
+
+# -- unpadding --------------------------------------------------------------
+
+def test_unpadding_slices_multi_row_requests():
+    srv = _server(warmup=True)
+    try:
+        srv.pause()
+        xa = np.random.rand(3, 4).astype(np.float32)
+        xb = np.random.rand(2, 4).astype(np.float32)
+        fa, fb = srv.submit(xa), srv.submit(xb)
+        srv.resume()
+        ya, yb = fa.result(timeout=30), fb.result(timeout=30)
+        w = _weight().asnumpy()
+        assert ya.shape == (3, 3) and yb.shape == (2, 3)
+        np.testing.assert_allclose(ya.asnumpy(), xa @ w, rtol=1e-5)
+        np.testing.assert_allclose(yb.asnumpy(), xb @ w, rtol=1e-5)
+        # 5 rows coalesced -> one bucket-8 call
+        assert srv.stats()["buckets"][8]["batches"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_request_shape_validation():
+    srv = _server(warmup=False, start=False)
+    try:
+        with pytest.raises(ValueError):
+            srv.submit(np.ones((1, 5), np.float32))   # wrong item shape
+        with pytest.raises(ValueError):
+            srv.submit(np.ones((9, 4), np.float32))   # rows > max_batch
+    finally:
+        srv.shutdown()
+
+
+# -- acceptance (c): overload -----------------------------------------------
+
+def test_queue_full_sheds_while_inflight_completes():
+    srv = _server(warmup=True, max_queue=4)
+    try:
+        srv.pause()
+        futs = [srv.submit(np.ones((1, 4), np.float32)) for _ in range(4)]
+        with pytest.raises(QueueFullError):
+            srv.submit(np.ones((1, 4), np.float32))
+        srv.resume()
+        for f in futs:  # admitted requests still complete
+            assert f.result(timeout=30).shape == (1, 3)
+        assert srv.metrics.total_shed == 1
+        assert srv.stats()["shed"]["queue_full"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_short_deadline_served_when_device_idle():
+    """A timeout shorter than the batching window must cap the wait —
+    the idle device dispatches just before expiry instead of shedding."""
+    srv = _server(warmup=True, max_delay_ms=300)
+    try:
+        out = srv.predict(np.ones((1, 4), np.float32), timeout_ms=60)
+        assert out.shape == (1, 3)
+        assert srv.stats()["shed"] == {}
+    finally:
+        srv.shutdown()
+
+
+def test_batcher_rejects_oversize_rows_directly():
+    """DynamicBatcher.submit is public API: rows > max_batch must raise,
+    not wedge the collect loop into a hot spin."""
+    srv = _server(warmup=False, start=False)
+    try:
+        with pytest.raises(ValueError):
+            srv._batcher.submit(np.zeros((9, 4), np.float32), 9)
+    finally:
+        srv.shutdown()
+
+
+def test_warmup_after_start_no_duplicate_compiles():
+    """warmup() on an already-serving server is safe (device calls are
+    serialized with the worker) and never double-compiles a bucket."""
+    srv = _server(warmup=False)  # worker running, nothing warmed
+    try:
+        futs = [srv.submit(np.ones((1, 4), np.float32)) for _ in range(4)]
+        srv.warmup()
+        for f in futs:
+            assert f.result(timeout=30).shape == (1, 3)
+        assert srv.compile_count == len(srv.policy.buckets)
+    finally:
+        srv.shutdown()
+
+
+def test_second_server_does_not_reset_shared_counters():
+    """Constructing another server must not zero the shared 'serving'
+    profiler-domain counters the first one already recorded."""
+    s1 = _server(warmup=True)
+    try:
+        s1.predict(np.ones((1, 4), np.float32))
+        before = json.loads(profiler.dumps(
+            format="json"))["counters"]["serving::requests"]
+        s2 = _server(warmup=True)
+        try:
+            s2.predict(np.ones((1, 4), np.float32))
+        finally:
+            s2.shutdown()
+        after = json.loads(profiler.dumps(
+            format="json"))["counters"]["serving::requests"]
+        assert after == before + 1
+    finally:
+        s1.shutdown()
+
+
+def test_deadline_shedding():
+    srv = _server(warmup=True)
+    try:
+        srv.pause()
+        doomed = srv.submit(np.ones((1, 4), np.float32), timeout_ms=5)
+        live = srv.submit(np.ones((1, 4), np.float32))
+        time.sleep(0.05)
+        srv.resume()
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=30)
+        assert live.result(timeout=30).shape == (1, 3)
+        assert srv.stats()["shed"]["deadline"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_worker_survives_shedding_entire_queue():
+    """Regression: expiring EVERY queued request must not kill the
+    worker (the empty-queue collect after shedding crashed the loop,
+    found by examples/serve_mnist.py)."""
+    srv = _server(warmup=True)
+    try:
+        srv.pause()
+        doomed = srv.submit(np.ones((1, 4), np.float32), timeout_ms=1)
+        time.sleep(0.03)
+        srv.resume()
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=30)
+        # the worker survived and keeps serving
+        assert srv.predict(np.ones((1, 4), np.float32)).shape == (1, 3)
+        assert srv._batcher._thread.is_alive()
+    finally:
+        srv.shutdown()
+
+
+def test_cancelled_requests_are_dropped_not_fatal():
+    """A client-cancelled future must not kill the worker or fail its
+    co-batched neighbors — whether it is dropped at shed time (expired)
+    or at dispatch time (set_running_or_notify_cancel)."""
+    srv = _server(warmup=True)
+    try:
+        srv.pause()
+        expired = srv.submit(np.ones((1, 4), np.float32), timeout_ms=1)
+        at_dispatch = srv.submit(np.ones((1, 4), np.float32))
+        live = srv.submit(np.ones((2, 4), np.float32))
+        assert expired.cancel() and at_dispatch.cancel()
+        time.sleep(0.03)
+        srv.resume()
+        assert live.result(timeout=30).shape == (2, 3)
+        assert srv._batcher._thread.is_alive()
+        # cancelled requests ran no device work and were not mis-shed
+        assert srv.predict(np.ones((1, 4), np.float32)).shape == (1, 3)
+    finally:
+        srv.shutdown()
+
+
+def test_submit_snapshots_caller_buffer():
+    """submit() must copy the request: callers may reuse their input
+    buffer immediately, while the worker reads it a delay window later."""
+    srv = _server(warmup=True)
+    try:
+        srv.pause()
+        buf = np.ones((1, 4), np.float32)
+        f1 = srv.submit(buf)
+        buf[:] = 5.0  # reuse the buffer before the batch dispatches
+        f2 = srv.submit(buf)
+        srv.resume()
+        w = _weight().asnumpy()
+        np.testing.assert_allclose(f1.result(timeout=30).asnumpy(),
+                                   np.ones((1, 4)) @ w, rtol=1e-5)
+        np.testing.assert_allclose(f2.result(timeout=30).asnumpy(),
+                                   np.full((1, 4), 5.0) @ w, rtol=1e-5)
+    finally:
+        srv.shutdown()
+
+
+# -- metrics / profiler integration -----------------------------------------
+
+def test_profiler_dumps_contains_per_bucket_serving_stats():
+    profiler.dumps(reset=True)
+    srv = _server(warmup=True)
+    try:
+        for _ in range(3):
+            srv.predict(np.ones((2, 4), np.float32))
+    finally:
+        srv.shutdown()
+    table = profiler.dumps()
+    assert "serving::bucket_2" in table
+    payload = json.loads(profiler.dumps(format="json"))
+    assert payload["ops"]["serving::bucket_2"]["calls"] == 3
+    assert payload["counters"]["serving::requests"] >= 3
+    snap = srv.stats()["buckets"][2]
+    assert snap["requests"] == 3 and snap["mean_occupancy"] == 1.0
+    assert snap["p99_ms"] >= snap["p50_ms"] > 0
+
+
+# -- checkpoint backend -----------------------------------------------------
+
+def test_from_checkpoint_matches_direct_forward(tmp_path):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=6, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = {"fc1_weight": mx.nd.array(np.random.randn(6, 4) * 0.5),
+            "fc1_bias": mx.nd.zeros((6,)),
+            "fc2_weight": mx.nd.array(np.random.randn(3, 6) * 0.5),
+            "fc2_bias": mx.nd.zeros((3,))}
+    prefix = str(tmp_path / "mlp")
+    mx.model.save_checkpoint(prefix, 0, net, args, {})
+
+    x = np.random.rand(5, 4).astype(np.float32)
+    feed = dict(args, data=mx.nd.array(x),
+                softmax_label=mx.nd.zeros((5,)))
+    want = net.bind(mx.cpu(), feed).forward(is_train=False)[0].asnumpy()
+
+    with InferenceServer.from_checkpoint(
+            prefix, 0, item_shape=(4,), buckets=(1, 8),
+            max_delay_ms=5) as srv:
+        got = srv.predict(x)
+        assert srv.compile_count == len(srv.policy.buckets)
+        np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-5)
+
+
+# -- lifecycle hygiene ------------------------------------------------------
+
+def test_shutdown_drains_and_joins_worker():
+    srv = _server(warmup=True)
+    srv.pause()
+    futs = [srv.submit(np.ones((1, 4), np.float32)) for _ in range(3)]
+    srv.shutdown(drain=True)  # resumes, drains the queue, joins
+    for f in futs:
+        assert f.result(timeout=1).shape == (1, 3)
+    assert srv._batcher._thread is not None
+    assert not srv._batcher._thread.is_alive()
+    with pytest.raises(RuntimeError):
+        srv.submit(np.ones((1, 4), np.float32))
+
+
+def test_shutdown_before_start_fails_pending():
+    """A never-started server has no worker to drain through: shutdown
+    must fail queued futures, not leave them hanging forever."""
+    srv = _server(warmup=False, start=False)
+    fut = srv.submit(np.ones((1, 4), np.float32))
+    srv.shutdown(drain=True)
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=1)
+
+
+def test_shutdown_without_drain_fails_pending():
+    srv = _server(warmup=True)
+    srv.pause()
+    fut = srv.submit(np.ones((1, 4), np.float32))
+    srv.shutdown(drain=False)
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=1)
+
+
+def test_worker_threads_are_daemonized():
+    srv = _server(warmup=False)
+    try:
+        assert srv._batcher._thread.daemon
+        assert any(t.name == "mx-serving-batcher"
+                   for t in threading.enumerate())
+    finally:
+        srv.shutdown()
