@@ -41,6 +41,7 @@ _LAZY = {
     "init": ".initializer",
     "lr_scheduler": ".lr_scheduler",
     "callback": ".callback",
+    "checkpoint": ".checkpoint",
     "kvstore": ".kvstore",
     "kv": ".kvstore",
     "io": ".io",

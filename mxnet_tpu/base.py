@@ -18,6 +18,7 @@ __all__ = [
     "MXNetError",
     "check_call",
     "get_env",
+    "atomic_write",
     "string_types",
     "numeric_types",
     "integer_types",
@@ -41,6 +42,47 @@ def check_call(ret):
     so this only validates pseudo status codes from native extensions."""
     if ret != 0:
         raise MXNetError("native call failed with status %d" % ret)
+
+
+import contextlib
+
+# Read once at import (single-threaded): os.umask is a process-global
+# read-modify-write, so probing it per call from concurrent writers
+# could leave the process umask clobbered.
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+@contextlib.contextmanager
+def atomic_write(fname, mode="wb"):
+    """Crash-safe file write: yields a handle to a same-directory temp
+    file; on clean exit the content is fsynced and renamed over `fname`
+    in one atomic step, on error the temp is removed. A crash at any
+    byte leaves either the old file or a stray ``.tmp*``, never a
+    truncated `fname` (the single-file commit protocol shared by
+    nd.save, symbol.save, and the optimizer-state writers). The temp
+    name comes from mkstemp, so concurrent writers (e.g. a background
+    checkpoint thread and the main loop) can never clobber each other's
+    staging file."""
+    import tempfile
+
+    d, base = os.path.split(os.path.abspath(fname))
+    fd, tmp = tempfile.mkstemp(prefix=base + ".tmp", dir=d)
+    # mkstemp creates 0600; restore normal umask-based permissions so
+    # checkpoints stay readable by the same consumers as before.
+    try:
+        os.fchmod(fd, 0o666 & ~_UMASK)
+    except OSError:
+        pass
+    try:
+        with os.fdopen(fd, mode) as f:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fname)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 string_types = (str,)

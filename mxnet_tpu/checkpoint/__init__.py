@@ -1,0 +1,40 @@
+"""mxnet_tpu.checkpoint — fault-tolerant async checkpointing.
+
+The training-side durability subsystem: atomic-commit checkpoint
+directories written off the critical path, integrity-verified restore
+that always lands on the last fully committed step, sharded per-process
+SPMD saves, and a preemption hook that turns SIGTERM into one final
+synchronous save.
+
+Quick start::
+
+    from mxnet_tpu import checkpoint
+
+    mgr = checkpoint.CheckpointManager("ckpt/", keep_last=3, keep_every=100)
+    step = parallel.TrainStep(net, loss_fn, ...)
+    hook = checkpoint.PreemptionHook(
+        mgr, state_fn=step.state_dict,
+        step_fn=lambda: step.num_update).install()
+
+    start = 0
+    latest = mgr.latest_step()
+    if latest is not None:
+        start, state = mgr.restore()
+        step.load_state_dict(state)
+    for s in range(start, num_steps):
+        loss = step(x, y)
+        mgr.save(s + 1, step.state_dict())    # async, ~zero step cost
+    mgr.close()
+"""
+from .manager import CheckpointManager, Shard, CheckpointNotFoundError, \
+    CheckpointCorruptError
+from .preempt import PreemptionHook
+from .state import state_dict, load_state_dict, module_state, \
+    load_module_state, block_state, load_block_state, trainer_state, \
+    load_trainer_state
+
+__all__ = ["CheckpointManager", "Shard", "CheckpointNotFoundError",
+           "CheckpointCorruptError", "PreemptionHook", "state_dict",
+           "load_state_dict", "module_state", "load_module_state",
+           "block_state", "load_block_state", "trainer_state",
+           "load_trainer_state"]

@@ -1,0 +1,834 @@
+"""CheckpointManager — fault-tolerant async checkpointing with atomic
+commit.
+
+The reference's durability story (model.py:save_checkpoint → one
+blocking `nd.save`) has two production gaps on preemptible fleets: a
+crash mid-save can leave a truncated-but-loadable `.params`, and every
+save stalls the training step for the full serialize+write. This
+manager closes both:
+
+* **Atomic commit.** A checkpoint is a *directory* `step-<N>/` holding
+  one raw shard file per writing process plus a `manifest.json` (step,
+  per-array shapes/dtypes/offsets/CRC32s). Everything is first written
+  into a `tmp.*` staging directory and fsynced; the commit is a single
+  `os.rename` of the staging dir onto the final name. Readers only ever
+  see fully written checkpoints — a kill at ANY byte of the save leaves
+  either the previous commit or a `tmp.*` orphan that `restore()`
+  ignores and GC sweeps.
+* **Async saves.** `save(step, state)` snapshots device arrays to host
+  at the step boundary (the only synchronous cost), then a background
+  writer thread serializes, commits, and runs retention GC off the
+  critical path. `save(..., sync=True)` keeps the whole write on the
+  calling thread (preemption hooks, tests).
+* **Corruption-proof restore.** `restore()` walks committed steps
+  newest-first, verifying manifest integrity and per-chunk length +
+  CRC32; a corrupt or torn checkpoint is skipped with a warning and the
+  next older commit is returned. Transient IO errors during writes are
+  retried with bounded exponential backoff.
+* **Sharded SPMD saves.** A state leaf may be a :class:`Shard` — the
+  locally-addressable chunks of a globally sharded array. Each process
+  writes only its own shard file; process 0 stitches the per-process
+  part-manifests into the final manifest and performs the commit
+  rename, so a pod-wide checkpoint is still one atomic event.
+
+Telemetry rides ``mx.profiler``: counters ``checkpoint::save_seconds``,
+``checkpoint::bytes`` (cumulative) and ``checkpoint::pending`` (gauge)
+show up in ``profiler.dumps()``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import shutil
+import threading
+import time
+import zlib
+
+import numpy as np
+
+__all__ = ["CheckpointManager", "Shard", "CheckpointNotFoundError",
+           "CheckpointCorruptError"]
+
+_FORMAT = "mxnet_tpu.checkpoint/1"
+_STEP_PREFIX = "step-"
+_TMP_PREFIX = "tmp."
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointNotFoundError(FileNotFoundError):
+    """No fully committed, uncorrupted checkpoint exists."""
+
+
+class CheckpointCorruptError(ValueError):
+    """A committed checkpoint failed integrity verification."""
+
+
+# -- fault-injection seams ----------------------------------------------------
+# All checkpoint writes/commits go through these module-level hooks so the
+# test suite's `fault_fs` fixture can fail the first N writes or truncate a
+# file without touching real filesystem syscalls elsewhere in the process.
+
+def _open_for_write(path):
+    return open(path, "wb")
+
+
+def _rename(src, dst):
+    os.rename(src, dst)
+
+
+def _fsync_dir(path):
+    # Durability of the rename itself; not available on some platforms.
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# -- state flattening ---------------------------------------------------------
+
+class Shard:
+    """The locally-addressable pieces of a globally sharded array.
+
+    ``chunks`` is a list of ``(index, data)`` where ``index`` is a tuple
+    of ``(start, stop)`` per dimension into the global array and ``data``
+    is the host value of that slice. A process that holds nothing of the
+    array (pure replication, non-primary replica) passes ``chunks=[]``;
+    the manifest is stitched from whichever processes do hold pieces.
+    """
+
+    def __init__(self, shape, dtype, chunks):
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+        self.chunks = []
+        for index, data in chunks:
+            index = tuple((int(a), int(b)) for a, b in index)
+            # Copy host buffers (not just make contiguous): the writer
+            # serializes asynchronously, and a view of a caller-mutated
+            # array would commit torn bytes with a matching CRC.
+            if isinstance(data, np.ndarray):
+                data = np.array(data, copy=True)
+            else:
+                data = np.ascontiguousarray(data)
+            expect = tuple(b - a for a, b in index)
+            if tuple(data.shape) != expect:
+                raise ValueError(
+                    "Shard chunk shape %s does not match index %s"
+                    % (data.shape, index))
+            self.chunks.append((index, data))
+
+    def __repr__(self):
+        return "Shard(shape=%s, dtype=%s, chunks=%d)" % (
+            self.shape, self.dtype, len(self.chunks))
+
+
+def _flatten(state, prefix="", out=None):
+    """Nested dict -> flat {'a/b/c': leaf}. Keys must be '/'-free strs."""
+    if out is None:
+        out = {}
+    for key, value in state.items():
+        if not isinstance(key, str) or "/" in key:
+            raise ValueError(
+                "checkpoint state keys must be '/'-free strings, got %r"
+                % (key,))
+        full = prefix + key
+        if isinstance(value, dict):
+            _flatten(value, full + "/", out)
+        else:
+            out[full] = value
+    return out
+
+
+def _unflatten(flat):
+    out = {}
+    for key, value in flat.items():
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def _to_host(value):
+    """Snapshot one leaf to (host numpy | Shard, kind). Runs on the
+    caller's thread at the step boundary — the only synchronous cost of
+    an async save."""
+    if isinstance(value, Shard):
+        return value, "array"
+    if isinstance(value, (bytes, bytearray)):
+        return np.frombuffer(bytes(value), np.uint8).copy(), "bytes"
+    if isinstance(value, str):
+        return np.frombuffer(value.encode("utf-8"), np.uint8).copy(), "str"
+    if isinstance(value, (bool, np.bool_)):
+        return np.asarray(bool(value)), "bool"
+    if isinstance(value, (int, np.integer)):
+        return np.asarray(int(value), np.int64), "int"
+    if isinstance(value, (float, np.floating)):
+        return np.asarray(float(value), np.float64), "float"
+    if hasattr(value, "asnumpy"):                    # NDArray
+        return np.asarray(value.asnumpy()), "array"
+    if isinstance(value, np.ndarray):
+        # A live host buffer the caller may keep mutating — the
+        # background writer must serialize THIS step's bytes, and the
+        # CRC is computed at write time from the same object, so an
+        # aliased view would commit silently torn data as "intact".
+        return value.copy(), "array"
+    return np.asarray(value), "array"                # jax (immutable)
+
+
+def _from_host(arr, kind):
+    if kind == "array":
+        return arr
+    if kind == "bytes":
+        return arr.tobytes()
+    if kind == "str":
+        return arr.tobytes().decode("utf-8")
+    if kind == "bool":
+        return bool(arr)
+    if kind == "int":
+        return int(arr)
+    if kind == "float":
+        return float(arr)
+    raise CheckpointCorruptError("unknown leaf kind %r" % (kind,))
+
+
+def _dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes  # bfloat16 & friends register via ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+        except (ImportError, AttributeError, TypeError):
+            # A damaged manifest must read as corrupt (restore falls
+            # back to an older commit), not crash the restore walk.
+            raise CheckpointCorruptError("unknown dtype %r" % (name,))
+
+
+# -- the manager --------------------------------------------------------------
+
+class CheckpointManager:
+    """Directory-of-steps checkpoint store with async atomic commits.
+
+    Parameters
+    ----------
+    directory : str — root; each commit is `<directory>/step-<N>/`.
+    keep_last : int — retention: newest N commits survive GC (0/None
+        disables GC entirely).
+    keep_every : int or None — additionally keep every commit whose step
+        is a multiple of K (archival ladder).
+    max_retries : int — transient-IO retry budget per save (exponential
+        backoff, base `retry_backoff` seconds).
+    process_index / process_count : SPMD identity; defaults from
+        `parallel.dist` when initialized, else single-process. Only
+        process 0 stitches manifests, commits, and GCs.
+    stitch_timeout : float — how long process 0 waits for the other
+        processes' part-manifests before declaring the save failed.
+    max_pending : int — bound on queued async snapshots (each holds a
+        full host copy of the state). When the writer falls behind the
+        save cadence, the OLDEST queued snapshot is dropped (latest
+        wins) instead of growing host memory without bound.
+    fsync : 'commit' (default) | 'full' | 'none' — durability of each
+        commit. Process death (preemption, crash, SIGKILL) never loses
+        page-cache writes, so for the fleet threat model no fsync is
+        strictly needed; 'commit' fsyncs only the small manifest +
+        directory so the commit marker itself is power-loss durable,
+        while a power cut that tears the bulk shard data is caught by
+        restore()'s CRC check and falls back to the previous commit.
+        'full' additionally fsyncs shard data (bounded power-loss
+        window, pays disk latency on the writer thread); 'none' skips
+        all fsyncs.
+    """
+
+    def __init__(self, directory, keep_last=3, keep_every=None,
+                 max_retries=3, retry_backoff=0.05,
+                 process_index=None, process_count=None,
+                 stitch_timeout=60.0, fsync="commit", max_pending=2):
+        if process_index is None or process_count is None:
+            try:
+                from ..parallel import dist
+
+                if dist.is_initialized():
+                    process_index = dist.rank()
+                    process_count = dist.num_processes()
+            except Exception:
+                pass
+        self.process_index = int(process_index or 0)
+        self.process_count = int(process_count or 1)
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self.max_retries = int(max_retries)
+        self.retry_backoff = float(retry_backoff)
+        self.stitch_timeout = float(stitch_timeout)
+        if fsync not in ("none", "commit", "full"):
+            raise ValueError("fsync must be 'none', 'commit' or 'full', "
+                             "got %r" % (fsync,))
+        self.fsync = fsync
+        self.max_pending = int(max_pending)
+        self.dropped_saves = 0
+        self.last_error = None
+        self.total_bytes = 0
+        self.total_save_seconds = 0.0
+
+        self._fs_lock = threading.RLock()
+        self._queue = queue.Queue()
+        self._thread = None
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._closed = False
+
+        # Counters are process-global telemetry shared by every manager:
+        # never pass an initial value here — that would zero cumulative
+        # history (and corrupt the pending gauge) each time a second
+        # manager is constructed.
+        from .. import profiler
+
+        domain = profiler.Domain("checkpoint")
+        self._c_seconds = domain.new_counter("save_seconds")
+        self._c_bytes = domain.new_counter("bytes")
+        self._c_pending = domain.new_counter("pending")
+        self._quiet = False     # signal-handler mode: skip lock-taking
+        #                         telemetry (see PreemptionHook)
+
+    # -- paths ----------------------------------------------------------------
+
+    def _step_dir(self, step):
+        return os.path.join(self.directory, "%s%08d" % (_STEP_PREFIX, step))
+
+    def _tmp_dir(self, step):
+        # Multi-process saves share one deterministic staging dir; a
+        # single process suffixes its pid so an orphan from a previous
+        # incarnation can never collide with a live write.
+        if self.process_count > 1:
+            return os.path.join(self.directory,
+                                "%sstep-%08d" % (_TMP_PREFIX, step))
+        return os.path.join(self.directory, "%sstep-%08d.%d"
+                            % (_TMP_PREFIX, step, os.getpid()))
+
+    def _shard_name(self, index):
+        return "shard-%05d-of-%05d.bin" % (index, self.process_count)
+
+    def _part_name(self, index):
+        return "manifest-part-%05d.json" % index
+
+    # -- public API -----------------------------------------------------------
+
+    @property
+    def pending(self):
+        """Number of queued-or-in-flight async saves."""
+        with self._pending_lock:
+            return self._pending
+
+    def save(self, step, state, sync=False):
+        """Checkpoint `state` (a nested dict of arrays / Shards / small
+        scalars) as `step`. Device values are snapshotted to host NOW;
+        serialization + commit happen on the writer thread unless
+        ``sync=True``. Returns immediately in async mode."""
+        if self._closed:
+            raise RuntimeError("CheckpointManager is closed")
+        step = int(step)
+        snap = {k: _to_host(v) for k, v in _flatten(state).items()}
+        if sync:
+            self._write_with_retry(step, snap)
+            return
+        self._ensure_thread()
+        # Backpressure: each queued item is a full host snapshot. If the
+        # writer is slower than the save cadence, drop the oldest queued
+        # snapshot (the newest state is the one worth keeping) rather
+        # than growing host memory one checkpoint per step.
+        # Single-process only: a multi-process save is collective, and a
+        # rank dropping a step its peers kept would stall rank 0's
+        # stitch for the full timeout — coordinated drops are a ROADMAP
+        # follow-up.
+        while self.max_pending and self.process_count == 1 and \
+                self._queue.qsize() >= self.max_pending:
+            try:
+                dropped_step, _ = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._queue.task_done()
+            with self._pending_lock:
+                self._pending -= 1
+            self._bump(self._c_pending, -1)
+            self.dropped_saves += 1
+            log.warning("checkpoint writer backlogged; dropping queued "
+                        "save for step %d (latest wins)", dropped_step)
+        with self._pending_lock:
+            self._pending += 1
+        self._bump(self._c_pending, 1)
+        self._queue.put((step, snap))
+
+    def wait(self):
+        """Block until every queued async save has committed (or failed;
+        see `last_error`)."""
+        self._queue.join()
+
+    def drain(self, timeout=None, poll=0.01):
+        """Lock-free wait for queued saves: polls the queue's unfinished
+        counter without acquiring its mutex, so it is safe from a signal
+        handler that may have interrupted a frame holding that mutex
+        (queue.join() is not). Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._queue.unfinished_tasks:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(poll)
+        return True
+
+    def close(self):
+        """Flush pending saves and stop the writer thread."""
+        if self._closed:
+            return
+        self.wait()
+        self._closed = True
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def all_steps(self):
+        """Sorted steps with a committed, manifest-bearing directory."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return steps
+        for name in names:
+            if not name.startswith(_STEP_PREFIX):
+                continue
+            try:
+                step = int(name[len(_STEP_PREFIX):])
+            except ValueError:
+                continue
+            if os.path.isfile(os.path.join(self.directory, name,
+                                           "manifest.json")):
+                steps.append(step)
+        return sorted(steps)
+
+    def latest_step(self):
+        """Newest committed step, or None. Commit-level check only; a
+        checksum-corrupt commit is detected (and skipped) by restore."""
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step=None):
+        """Return ``(step, state)`` for the newest fully-committed,
+        integrity-verified checkpoint (or exactly `step` if given).
+        Incomplete or corrupt checkpoints are skipped newest-first;
+        raises CheckpointNotFoundError when nothing restorable exists."""
+        if step is not None:
+            return int(step), self._load(int(step))
+        for s in reversed(self.all_steps()):
+            try:
+                return s, self._load(s)
+            except (CheckpointCorruptError, OSError, ValueError,
+                    KeyError) as exc:
+                log.warning("checkpoint step %d unreadable (%s); trying "
+                            "older", s, exc)
+        raise CheckpointNotFoundError(
+            "no restorable checkpoint under %r" % self.directory)
+
+    # -- writer ---------------------------------------------------------------
+
+    def _ensure_thread(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker,
+                                            name="ckpt-writer", daemon=True)
+            self._thread.start()
+
+    def _worker(self):
+        # Deprioritize the writer: serialization/CRC/IO should fill idle
+        # host cycles, not steal cores from compute or the input
+        # pipeline (thread-level nice is a Linux-ism; elsewhere this is
+        # a no-op and the thread runs at normal priority).
+        try:
+            os.setpriority(os.PRIO_PROCESS, threading.get_native_id(), 10)
+        except (AttributeError, OSError):
+            pass
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            step, snap = item
+            try:
+                self._write_with_retry(step, snap)
+            except Exception as exc:  # keep the trainer alive
+                self.last_error = exc
+                self._warn("async checkpoint save for step %d failed: %s"
+                           % (step, exc))
+            finally:
+                with self._pending_lock:
+                    self._pending -= 1
+                self._bump(self._c_pending, -1)
+                self._queue.task_done()
+
+    def _cleanup_failed(self, step):
+        """Undo this process's contribution to a failed write. With
+        multiple processes the staging dir is shared — removing the
+        whole tree would destroy peers' already-written shards and turn
+        one transient local error into a pod-wide stitch timeout."""
+        tmp = self._tmp_dir(step)
+        if self.process_count == 1:
+            shutil.rmtree(tmp, ignore_errors=True)
+            return
+        for name in (self._shard_name(self.process_index),
+                     self._part_name(self.process_index),
+                     self._part_name(self.process_index) + ".wip",
+                     "manifest.json"):
+            try:
+                os.remove(os.path.join(tmp, name))
+            except OSError:
+                pass
+
+    def _write_with_retry(self, step, snap):
+        delay = self.retry_backoff
+        for attempt in range(self.max_retries + 1):
+            try:
+                self._write_once(step, snap)
+                return
+            except OSError as exc:
+                self._cleanup_failed(step)
+                if attempt == self.max_retries:
+                    self.last_error = exc
+                    raise
+                self._warn("checkpoint write for step %d failed (%s); "
+                           "retry %d/%d in %.2fs" % (step, exc, attempt + 1,
+                                                     self.max_retries, delay))
+                time.sleep(delay)
+                delay *= 2
+
+    def _write_once(self, step, snap):
+        with self._fs_lock:
+            t0 = time.perf_counter()
+            final = self._step_dir(step)
+            replace_torn = False
+            if os.path.isfile(os.path.join(final, "manifest.json")):
+                # Same step already committed (e.g. a preempt save raced
+                # an async one) — but only skip if that commit looks
+                # intact; a committed-but-torn step must not block its
+                # own re-save forever. _commit_intact is manifest+size
+                # level (no full read): this runs inside the preemption
+                # grace window, where re-CRCing a multi-GB checkpoint
+                # just to decide "skip" could eat the whole budget.
+                # Bit-rot within a correct length is still caught by
+                # restore()'s per-chunk CRC, which falls back a step.
+                if self._commit_intact(step):
+                    return
+                replace_torn = True
+            tmp = self._tmp_dir(step)
+            os.makedirs(tmp, exist_ok=True)
+            written = self._write_shard(tmp, snap)
+            if self.process_index != 0:
+                # Non-primary processes contribute their shard + part
+                # manifest; process 0 owns stitch/commit/GC.
+                self._account(t0, written)
+                return
+            entries = self._stitch_parts(tmp, step)
+            manifest = {"format": _FORMAT, "step": step,
+                        "process_count": self.process_count,
+                        "shards": [self._shard_name(i)
+                                   for i in range(self.process_count)],
+                        "arrays": entries}
+            blob = json.dumps(manifest, sort_keys=True).encode("utf-8")
+            f = _open_for_write(os.path.join(tmp, "manifest.json"))
+            try:
+                f.write(blob)
+                if self.fsync != "none":
+                    f.flush()
+                    os.fsync(f.fileno())
+            finally:
+                f.close()
+            if replace_torn:
+                # The fresh replacement is fully staged; only now drop
+                # the broken commit (worst case: a crash here leaves the
+                # tmp dir, and restore falls back exactly as before).
+                shutil.rmtree(final, ignore_errors=True)
+            try:
+                _rename(tmp, final)
+            except OSError:
+                if os.path.isfile(os.path.join(final, "manifest.json")):
+                    shutil.rmtree(tmp, ignore_errors=True)  # lost a race
+                else:
+                    raise
+            if self.fsync != "none":
+                _fsync_dir(self.directory)
+            self._account(t0, written + len(blob))
+            self._gc()
+
+    def _write_shard(self, tmp, snap):
+        """This process's raw chunk file + part manifest. Replicated
+        (non-Shard) leaves are written by process 0 only; Shard leaves
+        contribute whatever chunks this process holds."""
+        entries = {}
+        offset = 0
+        nbytes_total = 0
+        shard_path = os.path.join(tmp, self._shard_name(self.process_index))
+        f = _open_for_write(shard_path)
+        try:
+            for key in sorted(snap):
+                value, kind = snap[key]
+                if isinstance(value, Shard):
+                    chunks = [(idx, data) for idx, data in value.chunks]
+                    shape, dtype = value.shape, value.dtype
+                elif self.process_index == 0:
+                    chunks = [(None, value)]
+                    shape, dtype = value.shape, value.dtype
+                else:
+                    continue
+                entry = {"shape": list(shape), "dtype": str(dtype),
+                         "kind": kind, "chunks": []}
+                for index, data in chunks:
+                    # Zero-copy write: a flat byte view of the host
+                    # snapshot, not a tobytes() duplicate — the writer
+                    # thread shares cores with compute.
+                    raw = memoryview(np.ascontiguousarray(data)).cast("B")
+                    f.write(raw)
+                    entry["chunks"].append({
+                        "shard": self.process_index, "offset": offset,
+                        "nbytes": len(raw), "crc32": zlib.crc32(raw),
+                        "index": None if index is None
+                        else [list(p) for p in index]})
+                    offset += len(raw)
+                    nbytes_total += len(raw)
+                if entry["chunks"] or isinstance(value, Shard):
+                    entries[key] = entry
+            if self.fsync == "full":
+                f.flush()
+                os.fsync(f.fileno())
+        finally:
+            f.close()
+        part = json.dumps({"arrays": entries},
+                          sort_keys=True).encode("utf-8")
+        # Publish the part manifest atomically (write + rename): rank 0
+        # polls for these by name, and must never observe a part file
+        # that exists but has no bytes yet.
+        part_path = os.path.join(tmp, self._part_name(self.process_index))
+        pf = _open_for_write(part_path + ".wip")
+        try:
+            pf.write(part)
+            if self.fsync != "none":
+                pf.flush()
+                os.fsync(pf.fileno())
+        finally:
+            pf.close()
+        _rename(part_path + ".wip", part_path)
+        return nbytes_total
+
+    def _stitch_parts(self, tmp, step):
+        """Process 0: merge every process's part manifest (waiting up to
+        stitch_timeout for stragglers) into one arrays table."""
+        deadline = time.monotonic() + self.stitch_timeout
+        paths = [os.path.join(tmp, self._part_name(i))
+                 for i in range(self.process_count)]
+        while True:
+            missing = [p for p in paths if not os.path.isfile(p)]
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                raise OSError(
+                    "step %d: timed out waiting for checkpoint shards %s"
+                    % (step, [os.path.basename(p) for p in missing]))
+            time.sleep(0.01)
+        merged = {}
+        for path in paths:
+            try:
+                with open(path, "rb") as f:
+                    part = json.loads(f.read().decode("utf-8"))
+            except (OSError, ValueError) as exc:
+                # Parts are rename-published so this should not happen;
+                # surface it as a retryable IO failure either way.
+                raise OSError("step %d: unreadable checkpoint part %s "
+                              "(%s)" % (step, os.path.basename(path), exc))
+            for key, entry in part["arrays"].items():
+                if key in merged:
+                    merged[key]["chunks"].extend(entry["chunks"])
+                else:
+                    merged[key] = entry
+        for key, entry in merged.items():
+            if not entry["chunks"]:
+                raise OSError("step %d: no process wrote any chunk of %r"
+                              % (step, key))
+        return merged
+
+    def _bump(self, counter, delta):
+        """Best-effort profiler counter update that NEVER blocks: the
+        profiler's global lock may be held by the very main-thread frame
+        a preemption signal interrupted, and a checkpoint thread
+        blocking on it while holding _fs_lock would deadlock the
+        handler's final save. Under contention (or _quiet) the telemetry
+        tick is dropped — the authoritative totals live on the manager."""
+        if self._quiet:
+            return
+        from .. import profiler
+
+        if profiler._lock.acquire(blocking=False):
+            try:
+                key = counter._key()
+                profiler._counters[key] = \
+                    profiler._counters.get(key, 0) + delta
+            finally:
+                profiler._lock.release()
+
+    def _warn(self, msg):
+        """log.warning, except in signal-handler (_quiet) mode where the
+        logging lock may be held by the interrupted frame — there the
+        message goes straight to fd 2, which takes no locks."""
+        if self._quiet:
+            try:
+                os.write(2, (msg + "\n").encode())
+            except OSError:
+                pass
+        else:
+            log.warning("%s", msg)
+
+    def _account(self, t0, nbytes):
+        dt = time.perf_counter() - t0
+        self.total_bytes += nbytes
+        self.total_save_seconds += dt
+        self._bump(self._c_bytes, nbytes)
+        self._bump(self._c_seconds, dt)
+
+    def _gc(self):
+        """Retention: newest keep_last + every keep_every-th step; sweep
+        everything else, plus staging orphans older than the newest
+        commit (a crashed writer's leavings)."""
+        if not self.keep_last or self.process_index != 0:
+            return
+        steps = self.all_steps()
+        keep = set(steps[-int(self.keep_last):])
+        if self.keep_every:
+            keep.update(s for s in steps if s % int(self.keep_every) == 0)
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        latest = steps[-1] if steps else None
+        if latest is None:
+            return
+        for name in os.listdir(self.directory):
+            if not name.startswith(_TMP_PREFIX + "step-"):
+                continue
+            try:
+                s = int(name[len(_TMP_PREFIX) + 5:].split(".")[0])
+            except ValueError:
+                continue
+            if s <= latest:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    def _commit_intact(self, step):
+        """Cheap structural check of a committed step: manifest parses
+        and every shard file covers the extents the manifest claims.
+        Catches torn/truncated writes without reading the data bytes."""
+        root = self._step_dir(step)
+        try:
+            with open(os.path.join(root, "manifest.json"), "rb") as f:
+                manifest = json.loads(f.read().decode("utf-8"))
+            if manifest.get("format") != _FORMAT:
+                return False
+            need = {}
+            for entry in manifest["arrays"].values():
+                _dtype(entry["dtype"])
+                for chunk in entry["chunks"]:
+                    end = chunk["offset"] + chunk["nbytes"]
+                    sid = chunk["shard"]
+                    need[sid] = max(need.get(sid, 0), end)
+            for sid, end in need.items():
+                path = os.path.join(root, manifest["shards"][sid])
+                if os.path.getsize(path) < end:
+                    return False
+            return True
+        except Exception:
+            return False
+
+    # -- reader ---------------------------------------------------------------
+
+    def _load(self, step):
+        root = self._step_dir(step)
+        mpath = os.path.join(root, "manifest.json")
+        if not os.path.isfile(mpath):
+            raise CheckpointNotFoundError(
+                "step %d has no committed manifest" % step)
+        try:
+            with open(mpath, "rb") as f:
+                manifest = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError) as exc:
+            raise CheckpointCorruptError(
+                "step %d: unreadable manifest (%s)" % (step, exc))
+        if manifest.get("format") != _FORMAT:
+            raise CheckpointCorruptError(
+                "step %d: unknown manifest format %r"
+                % (step, manifest.get("format")))
+        shards = manifest["shards"]
+        handles = {}
+        try:
+            flat = {}
+            for key, entry in manifest["arrays"].items():
+                flat[key] = _from_host(
+                    self._read_entry(root, shards, handles, step, key,
+                                     entry), entry["kind"])
+        finally:
+            for h in handles.values():
+                h.close()
+        return _unflatten(flat)
+
+    def _read_entry(self, root, shards, handles, step, key, entry):
+        dtype = _dtype(entry["dtype"])
+        shape = tuple(entry["shape"])
+        out = np.empty(shape, dtype)
+        filled = 0
+        for chunk in entry["chunks"]:
+            sid = chunk["shard"]
+            if sid not in handles:
+                path = os.path.join(root, shards[sid])
+                try:
+                    handles[sid] = open(path, "rb")
+                except OSError as exc:
+                    raise CheckpointCorruptError(
+                        "step %d: missing shard %s (%s)"
+                        % (step, shards[sid], exc))
+            f = handles[sid]
+            f.seek(chunk["offset"])
+            raw = f.read(chunk["nbytes"])
+            if len(raw) != chunk["nbytes"]:
+                raise CheckpointCorruptError(
+                    "step %d: %r truncated in %s (%d of %d bytes)"
+                    % (step, key, shards[sid], len(raw), chunk["nbytes"]))
+            if zlib.crc32(raw) != chunk["crc32"]:
+                raise CheckpointCorruptError(
+                    "step %d: %r checksum mismatch in %s"
+                    % (step, key, shards[sid]))
+            index = chunk["index"]
+            if index is None:
+                out = np.frombuffer(raw, dtype).reshape(shape).copy()
+                filled = int(np.prod(shape, dtype=np.int64))
+            else:
+                sl = tuple(slice(a, b) for a, b in index)
+                piece = np.frombuffer(raw, dtype).reshape(
+                    tuple(b - a for a, b in index))
+                out[sl] = piece
+                filled += piece.size
+        if filled < int(np.prod(shape, dtype=np.int64)):
+            raise CheckpointCorruptError(
+                "step %d: %r chunks cover %d of %d elements"
+                % (step, key, filled,
+                   int(np.prod(shape, dtype=np.int64))))
+        return out

@@ -1,0 +1,157 @@
+"""state_dict / load_state_dict adapters for the training frontends.
+
+One checkpointable-state convention across every training API in the
+framework: a nested dict of host arrays (plus small scalars / bytes)
+that `CheckpointManager.save` can snapshot and `restore` hands back.
+
+Covered frontends:
+
+* ``module.Module`` — arg/aux params plus the updater's optimizer-state
+  pickle (reference save_checkpoint + save_optimizer_states, as one
+  object).
+* ``gluon.Block`` — flat attribute-path parameter dict (the
+  save_parameters naming, portable across prefixes).
+* ``gluon.Trainer`` — updater states (momentum etc.).
+* ``parallel.TrainStep`` — params, fused optimizer state, step counter
+  and RNG position; first-class ``TrainStep.state_dict()`` including
+  sharded per-process saves for SPMD meshes (Shard leaves; each host
+  snapshots only its addressable shards).
+
+``state_dict(obj)`` dispatches on type; ``load_state_dict(obj, state)``
+reverses it. Adapters are also importable individually for composite
+states, e.g.::
+
+    mgr.save(step, {"net": block_state(net), "trainer": trainer_state(tr)})
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["state_dict", "load_state_dict", "module_state",
+           "load_module_state", "block_state", "load_block_state",
+           "trainer_state", "load_trainer_state"]
+
+
+def state_dict(obj):
+    """Snapshot `obj` (Module / gluon Block / gluon Trainer / TrainStep)
+    as a nested dict of host values."""
+    from ..module.base_module import BaseModule
+    from ..gluon.block import Block
+    from ..gluon.trainer import Trainer
+    from ..parallel.train_step import TrainStep
+
+    if isinstance(obj, TrainStep):
+        return obj.state_dict()
+    if isinstance(obj, BaseModule):
+        return module_state(obj)
+    if isinstance(obj, Trainer):
+        return trainer_state(obj)
+    if isinstance(obj, Block):
+        return block_state(obj)
+    raise TypeError("no state adapter for %r" % type(obj).__name__)
+
+
+def load_state_dict(obj, state):
+    """Restore a `state_dict` snapshot onto `obj`."""
+    from ..module.base_module import BaseModule
+    from ..gluon.block import Block
+    from ..gluon.trainer import Trainer
+    from ..parallel.train_step import TrainStep
+
+    if isinstance(obj, TrainStep):
+        obj.load_state_dict(state)
+        return
+    if isinstance(obj, BaseModule):
+        load_module_state(obj, state)
+        return
+    if isinstance(obj, Trainer):
+        load_trainer_state(obj, state)
+        return
+    if isinstance(obj, Block):
+        load_block_state(obj, state)
+        return
+    raise TypeError("no state adapter for %r" % type(obj).__name__)
+
+
+# -- Module -------------------------------------------------------------------
+
+def _module_updater(mod):
+    # The live updater: with update_on_kvstore the kvstore's internal
+    # one receives the updates, not mod._updater.
+    return getattr(mod, "_active_updater", None) or mod._updater
+
+
+def module_state(mod, include_optimizer=True):
+    arg_params, aux_params = mod.get_params()
+    state = {"kind": "module",
+             "arg": {n: v.asnumpy() for n, v in arg_params.items()},
+             "aux": {n: v.asnumpy() for n, v in aux_params.items()}}
+    if include_optimizer and getattr(mod, "optimizer_initialized", False):
+        state["opt_states"] = _module_updater(mod).get_states(
+            dump_optimizer=False)
+    return state
+
+
+def load_module_state(mod, state):
+    from .. import ndarray as nd
+
+    arg = {n: nd.array(v) for n, v in state.get("arg", {}).items()}
+    aux = {n: nd.array(v) for n, v in state.get("aux", {}).items()}
+    if mod.binded:
+        mod.set_params(arg, aux)
+        # A live update-on-kvstore module pulls weights back FROM the
+        # store each update — refresh its copies or the next update
+        # reverts the restore.
+        sync = getattr(mod, "_sync_params_to_kvstore", None)
+        if sync is not None:
+            sync()
+    else:
+        mod._arg_params = arg
+        mod._aux_params = aux
+        mod._preload_params = (arg, aux)
+    blob = state.get("opt_states")
+    if blob is None:
+        return
+    if getattr(mod, "optimizer_initialized", False):
+        _module_updater(mod).set_states(blob)
+    else:
+        # Natural restore order is restore -> init_optimizer: stash the
+        # blob for init_optimizer to apply (mirrors _preload_params) —
+        # silently dropping it would restart momentum at zero and break
+        # bit-exact resume with no error.
+        mod._preload_opt_state_blob = blob
+
+
+# -- gluon Block --------------------------------------------------------------
+
+def block_state(net):
+    params = net._collect_params_with_prefix()
+    return {"kind": "block",
+            "params": {n: p.data().asnumpy() for n, p in params.items()
+                       if p._data is not None}}
+
+
+def load_block_state(net, state, ctx=None):
+    from .. import ndarray as nd
+
+    params = net._collect_params_with_prefix()
+    loaded = state.get("params", {})
+    for name, p in params.items():
+        if name not in loaded:
+            raise ValueError("parameter %s missing in checkpoint" % name)
+        if p.shape is None or p._data is None:
+            p.shape = loaded[name].shape
+            p.initialize(ctx=ctx)
+        p.set_data(nd.array(np.asarray(loaded[name])))
+
+
+# -- gluon Trainer ------------------------------------------------------------
+
+def trainer_state(trainer):
+    return {"kind": "trainer",
+            "opt_states": trainer._updater.get_states(dump_optimizer=False)}
+
+
+def load_trainer_state(trainer, state):
+    trainer._updater.set_states(state["opt_states"])
+    trainer._updater.optimizer = trainer._optimizer

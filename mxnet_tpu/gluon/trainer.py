@@ -198,8 +198,12 @@ class Trainer:
                 d[:] = datas[0].as_in_context(d.context)
 
     def save_states(self, fname):
-        """Reference: trainer.py:save_states — updater state pickles."""
-        with open(fname, "wb") as f:
+        """Reference: trainer.py:save_states — updater state pickles.
+        Atomic (tmp + rename) so a mid-save crash never leaves a
+        truncated pickle."""
+        from ..base import atomic_write
+
+        with atomic_write(fname) as f:
             f.write(self._updater.get_states(dump_optimizer=False))
 
     def load_states(self, fname):

@@ -235,6 +235,38 @@ class Module(BaseModule):
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
         self.optimizer_initialized = True
+        # Optimizer state restored (checkpoint.load_module_state) before
+        # the optimizer existed: apply it now.
+        blob = getattr(self, "_preload_opt_state_blob", None)
+        if blob is not None:
+            self._active_updater.set_states(blob)
+            self._preload_opt_state_blob = None
+
+    def _sync_params_to_kvstore(self):
+        """Overwrite the kvstore's stored weight copies with the
+        executors' current values. With update_on_kvstore the store's
+        copy is authoritative (update pushes grads then PULLS weights
+        back), so set_params on a live module must refresh it or the
+        next update reverts the restore."""
+        kv = self._kvstore
+        if kv is None or not hasattr(kv, "_store"):
+            return  # dist stores: restore before init_optimizer instead
+        for i, name in enumerate(self._param_names):
+            if i in kv._store:
+                value = self._execs[0].arg_dict[name]
+                kv._store[i][:] = value.as_in_context(
+                    kv._store[i].context)
+
+    @property
+    def _active_updater(self):
+        """The updater that actually receives updates: with
+        update_on_kvstore the kvstore's internal updater is live and
+        `self._updater` stays pristine — checkpointing the wrong one
+        silently restarts momentum from zero."""
+        if self._update_on_kvstore and self._kvstore is not None and \
+                getattr(self._kvstore, "_updater", None) is not None:
+            return self._kvstore._updater
+        return self._updater
 
     # -- compute --------------------------------------------------------------
 
@@ -366,13 +398,17 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer=False))
+        # Atomic: a crash mid-save must not leave a truncated .states
+        # that later unpickles garbage.
+        from ..base import atomic_write
+
+        with atomic_write(fname) as f:
+            f.write(self._active_updater.get_states(dump_optimizer=False))
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            self._active_updater.set_states(f.read())
 
     def reshape(self, data_shapes, label_shapes=None):
         """(reference module.py:reshape — bucketing support)."""

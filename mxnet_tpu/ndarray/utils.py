@@ -14,7 +14,6 @@ still loadable.
 """
 from __future__ import annotations
 
-import os
 import struct
 
 import numpy as np
@@ -186,8 +185,11 @@ def save(fname, data):
         arrays = list(data)
     else:
         raise TypeError("save expects NDArray, list or dict")
-    tmp = fname + ".tmp%d" % os.getpid()
-    with open(tmp, "wb") as f:
+    # Atomic commit: a crash at any byte leaves either the old file or
+    # a stray .tmp, never a truncated-but-loadable .params.
+    from ..base import atomic_write
+
+    with atomic_write(fname) as f:
         f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
         f.write(struct.pack("<Q", len(arrays)))
         for a in arrays:
@@ -197,7 +199,6 @@ def save(fname, data):
             b = n.encode("utf-8")
             f.write(struct.pack("<Q", len(b)))
             f.write(b)
-    os.replace(tmp, fname)
 
 
 def _load_npz(fname):

@@ -366,6 +366,10 @@ class TrainStep:
         params = list(net.collect_params().values())
         if any(p._data is None and p._deferred_init is not None
                for p in params):
+            if x_example is None:
+                raise RuntimeError(
+                    "net has deferred-init parameters; run one step (or "
+                    "a forward) before load_state_dict so shapes exist")
             with autograd.pause():
                 net(NDArray(jnp.asarray(x_example)))
             params = list(net.collect_params().values())
@@ -402,6 +406,9 @@ class TrainStep:
         self._opt_state = {
             n: tuple(self._place(s, self._shardings[n]) for s in st)
             for n, st in self._opt_state.items()}
+        self._ckpt_view = (self._param_vals, self._opt_state,
+                           self._aux_vals, self.num_update,
+                           _random.get_state())
         self._materialized = True
 
     # -- the pure step --------------------------------------------------------
@@ -577,13 +584,20 @@ class TrainStep:
         else:
             x = jax.device_put(jnp.asarray(x), self._data_sharding)
             y = jax.device_put(jnp.asarray(y), self._data_sharding)
-        self.num_update += 1
+        t = self.num_update + 1
         key = _random.next_key()
-        (self._param_vals, self._opt_state, self._aux_vals,
-         loss) = self._jitted(self._param_vals, self._opt_state,
-                              self._aux_vals, x, y,
-                              jnp.float32(self.lr),
-                              jnp.float32(self.num_update), key)
+        new_p, new_s, new_a, loss = self._jitted(
+            self._param_vals, self._opt_state, self._aux_vals, x, y,
+            jnp.float32(self.lr), jnp.float32(t), key)
+        # Single-bytecode commit of everything a checkpoint reads: a
+        # signal handler (checkpoint.PreemptionHook) can interrupt
+        # between any two statements here, and snapshotting params from
+        # step N with the counter/RNG of step N+1 would silently lose an
+        # update on resume. state_dict() reads THIS tuple.
+        self._ckpt_view = (new_p, new_s, new_a, t, _random.get_state())
+        self._param_vals, self._opt_state, self._aux_vals = \
+            new_p, new_s, new_a
+        self.num_update = t
         if self._multiproc:
             # The replicated loss is not fully addressable from one
             # controller; hand back this process's local replica so the
@@ -618,6 +632,115 @@ class TrainStep:
         return (self._gather_host(self._param_vals),
                 self._gather_host(self._opt_state),
                 self._gather_host(self._aux_vals))
+
+    # -- checkpoint-subsystem state (mxnet_tpu.checkpoint) --------------------
+
+    def _host_or_shard(self, arr):
+        """One array for state_dict: full host numpy when this process
+        can (and should) hold the whole value, else a checkpoint.Shard
+        of the locally-addressable primary-replica pieces."""
+        from ..checkpoint.manager import Shard
+
+        shards = [s for s in arr.addressable_shards if s.replica_id == 0]
+        if len(shards) == 1 and not self._multiproc and \
+                shards[0].data.shape == arr.shape:
+            return np.asarray(shards[0].data)
+        chunks = []
+        for s in shards:
+            index = tuple(
+                (sl.start if sl.start is not None else 0,
+                 sl.stop if sl.stop is not None else dim)
+                for sl, dim in zip(s.index, arr.shape))
+            chunks.append((index, np.asarray(s.data)))
+        return Shard(arr.shape, arr.dtype, chunks)
+
+    def state_dict(self, sharded=None):
+        """Checkpointable state as a nested host dict: params, fused
+        optimizer state, aux (BN stats), step counter and RNG position.
+
+        ``sharded`` (default: multi-process meshes only) snapshots each
+        array as the checkpoint.Shard of this process's addressable
+        primary-replica pieces — the per-host write contract of
+        `checkpoint.CheckpointManager`'s sharded SPMD saves. The
+        single-process path is one batched device_get (params are
+        donated buffers, so the snapshot must copy before the next
+        step). Restore with :meth:`load_state_dict`."""
+        if not self._materialized:
+            raise RuntimeError(
+                "run one step before state_dict so there is state to "
+                "snapshot")
+        if sharded is None:
+            sharded = self._multiproc
+        # _ckpt_view is committed by __call__ / load_state_dict /
+        # _materialize in ONE attribute store, so reading it here is
+        # signal-safe: a preemption handler interrupting mid-step sees
+        # either the pre-step or the post-step state, never a mix of
+        # step-N params with a step-N+1 counter.
+        pvals, opt_state, aux_vals, num_update, (seed, counter) = \
+            self._ckpt_view
+        opt_tree = {n: {str(i): s for i, s in enumerate(st)}
+                    for n, st in opt_state.items()}
+        if sharded:
+            conv = self._host_or_shard
+            params = {n: conv(v) for n, v in pvals.items()}
+            opt = {n: {k: conv(s) for k, s in d.items()}
+                   for n, d in opt_tree.items()}
+            aux = {n: conv(v) for n, v in aux_vals.items()}
+        else:
+            # One batched transfer for the whole snapshot — this is the
+            # entire synchronous cost of an async checkpoint.
+            params, opt, aux = jax.device_get(
+                (pvals, opt_tree, aux_vals))
+        return {
+            "params": params,
+            "opt": opt,
+            "aux": aux,
+            "num_update": int(num_update),
+            "rng": {"seed": int(seed), "counter": int(counter)},
+        }
+
+    def load_state_dict(self, state):
+        """Restore a :meth:`state_dict` snapshot (full host arrays — the
+        manager stitches sharded saves back together on restore) onto
+        this step's mesh. Resume is bit-exact: params, optimizer state,
+        step counter and the RNG stream position all continue as the
+        uninterrupted run would."""
+        if not self._materialized:
+            # Materialize from the net's initialized params so resume
+            # does not need a throwaway step (deferred-init nets must
+            # have run a forward once before this).
+            self._materialize(None)
+        # Empty sections (stateless optimizer, no BN aux) drop out of a
+        # flattened checkpoint entirely — absent means empty here.
+        params = state.get("params", {})
+        opt = state.get("opt", {})
+        aux = state.get("aux", {})
+
+        def place_as(value, like, sharding):
+            return self._place(np.asarray(value).astype(like.dtype),
+                               sharding)
+
+        # Build everything before mutating self: a mismatched snapshot
+        # must raise cleanly, not leave a half-loaded step.
+        new_p, new_s, new_a = {}, {}, {}
+        for n in self._param_vals:
+            new_p[n] = place_as(params[n], self._param_vals[n],
+                                self._shardings[n])
+            new_s[n] = tuple(
+                place_as(opt.get(n, {})[str(i)], s, self._shardings[n])
+                for i, s in enumerate(self._opt_state[n]))
+        for n in self._aux_vals:
+            new_a[n] = place_as(aux[n], self._aux_vals[n], self._repl)
+        num_update = int(state["num_update"])
+        rng = state.get("rng")
+
+        self._param_vals, self._opt_state, self._aux_vals = \
+            new_p, new_s, new_a
+        self.num_update = num_update
+        if rng is not None:
+            _random.set_state(int(rng["seed"]), int(rng["counter"]))
+        self._ckpt_view = (new_p, new_s, new_a, num_update,
+                           _random.get_state())
 
     def save_checkpoint(self, path):
         """Write params + optimizer state + aux + step counter in the
@@ -697,6 +820,8 @@ class TrainStep:
         if rng is not None:
             seed, counter = np.asarray(rng).ravel()
             _random.set_state(int(seed), int(counter))
+        self._ckpt_view = (new_p, new_s, new_a, num_update,
+                           _random.get_state())
 
     def sync_to_net(self):
         """Copy the (possibly sharded) param values back into the net's

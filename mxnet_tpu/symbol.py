@@ -484,7 +484,11 @@ class Symbol:
                            "mxnet_tpu_version": 1}, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        # Atomic: a crash mid-write must never leave a truncated-but-
+        # parseable symbol file next to valid params.
+        from .base import atomic_write
+
+        with atomic_write(fname, "w") as f:
             f.write(self.tojson())
 
     # -- execution ------------------------------------------------------------

@@ -49,3 +49,105 @@ def pytest_runtest_makereport(item, call):
     outcome = yield
     rep = outcome.get_result()
     setattr(item, "rep_" + rep.when, rep)
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection filesystem (mxnet_tpu.checkpoint durability tests)
+# ---------------------------------------------------------------------------
+
+class FaultInjector:
+    """Deterministic filesystem failures for the checkpoint write path.
+
+    Drives the `_open_for_write` / `_rename` seams in
+    mxnet_tpu.checkpoint.manager:
+
+    * ``fail_next_writes(n)`` — the next `n` file.write() calls raise
+      OSError (transient-IO retry behavior).
+    * ``fail_next_renames(n)`` — the next `n` commit renames raise
+      OSError (commit never lands → nothing partial becomes visible).
+    * ``truncate_next_file(keep)`` — the next file opened for writing is
+      truncated to `keep` bytes at close (a torn write that survives to
+      "commit"; restore must detect it via length/CRC and skip).
+    * ``corrupt(path, truncate_to=, flip_byte_at=)`` — damage an
+      already-committed file directly.
+    """
+
+    def __init__(self):
+        self.fail_writes = 0
+        self.fail_renames = 0
+        self.truncate_keep = None
+        self.writes_failed = 0
+        self.renames_failed = 0
+        self.files_truncated = 0
+
+    def fail_next_writes(self, n):
+        self.fail_writes = int(n)
+
+    def fail_next_renames(self, n):
+        self.fail_renames = int(n)
+
+    def truncate_next_file(self, keep_bytes):
+        self.truncate_keep = int(keep_bytes)
+
+    @staticmethod
+    def corrupt(path, truncate_to=None, flip_byte_at=None):
+        if truncate_to is not None:
+            with open(path, "r+b") as f:
+                f.truncate(truncate_to)
+        if flip_byte_at is not None:
+            with open(path, "r+b") as f:
+                f.seek(flip_byte_at)
+                b = f.read(1)
+                f.seek(flip_byte_at)
+                f.write(bytes([b[0] ^ 0xFF]))
+
+
+class _FaultyFile:
+    def __init__(self, f, injector, path):
+        self._f = f
+        self._inj = injector
+        self._path = path
+        self._truncate = injector.truncate_keep
+        if self._truncate is not None:
+            injector.truncate_keep = None
+
+    def write(self, data):
+        if self._inj.fail_writes > 0:
+            self._inj.fail_writes -= 1
+            self._inj.writes_failed += 1
+            raise OSError("injected write failure")
+        return self._f.write(data)
+
+    def close(self):
+        self._f.close()
+        if self._truncate is not None:
+            with open(self._path, "r+b") as f:
+                f.truncate(self._truncate)
+            self._inj.files_truncated += 1
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+@pytest.fixture
+def fault_fs(monkeypatch):
+    """Patch the checkpoint writer's IO seams with a FaultInjector."""
+    from mxnet_tpu.checkpoint import manager as ckpt_manager
+
+    inj = FaultInjector()
+    real_open = ckpt_manager._open_for_write
+    real_rename = ckpt_manager._rename
+
+    def faulty_open(path):
+        return _FaultyFile(real_open(path), inj, path)
+
+    def faulty_rename(src, dst):
+        if inj.fail_renames > 0:
+            inj.fail_renames -= 1
+            inj.renames_failed += 1
+            raise OSError("injected rename failure")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(ckpt_manager, "_open_for_write", faulty_open)
+    monkeypatch.setattr(ckpt_manager, "_rename", faulty_rename)
+    yield inj
