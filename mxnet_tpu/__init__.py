@@ -55,6 +55,7 @@ _LAZY = {
     "parallel": ".parallel",
     "profiler": ".profiler",
     "serving": ".serving",
+    "telemetry": ".telemetry",
     "test_utils": ".test_utils",
     "visualization": ".visualization",
     "viz": ".visualization",

@@ -26,8 +26,18 @@ from . import autograd
 from . import random as _random
 from .ops.registry import Operator, _freeze
 from .ndarray.ndarray import NDArray, _wrap_outputs
+from .telemetry import metrics as _tm
+from .telemetry import trace as _trace
 
 __all__ = ["CachedOp"]
+
+# Executable-cache fills per op — a climbing rate after warmup is a
+# recompile storm (telemetry.StepMonitor.attach watches the same event
+# through the on_trace hook).
+_compiles_total = _tm.REGISTRY.counter(
+    "mx_cachedop_compiles_total",
+    "CachedOp trace/compile events (one per shape-signature "
+    "executable-cache fill)", labels=("op",))
 
 
 class CachedOp:
@@ -67,11 +77,14 @@ class CachedOp:
 
         def pure(rng_key, *arrays, training=False):
             cached.num_traces += 1
+            _compiles_total.labels(op=name).inc()
             if cached.on_trace is not None:
                 cached.on_trace(cached)
             params = arrays[:cached._num_params]
             inputs = arrays[cached._num_params:]
-            with autograd.pause(train_mode=training):
+            with _trace.span("cached_op::trace", op=name,
+                             trace=cached.num_traces), \
+                    autograd.pause(train_mode=training):
                 with _random.trace_key_scope(rng_key) as scope:
                     nd_params = [NDArray(p) for p in params]
                     nd_inputs = [NDArray(x) for x in inputs]
@@ -103,12 +116,14 @@ class CachedOp:
 
         from .ops import registry as _reg
 
-        if autograd.is_recording():
-            raw = autograd._record_op(self._op, list(args), arrays, attrs)
-            result = _wrap_outputs(raw, ctx, out=out)
-            autograd._attach_outputs(result)
-            return result
-        raw = _reg.invoke_raw(self._op, arrays, attrs)
+        with _trace.span("cached_op::execute", op=self._op.name):
+            if autograd.is_recording():
+                raw = autograd._record_op(self._op, list(args), arrays,
+                                          attrs)
+                result = _wrap_outputs(raw, ctx, out=out)
+                autograd._attach_outputs(result)
+                return result
+            raw = _reg.invoke_raw(self._op, arrays, attrs)
         return _wrap_outputs(raw, ctx, out=out)
 
     def inference(self, *args, out=None):
@@ -125,5 +140,6 @@ class CachedOp:
 
         from .ops import registry as _reg
 
-        raw = _reg.invoke_raw(self._op, arrays, {"training": False})
+        with _trace.span("cached_op::inference", op=self._op.name):
+            raw = _reg.invoke_raw(self._op, arrays, {"training": False})
         return _wrap_outputs(raw, ctx, out=out)

@@ -31,12 +31,16 @@ manager closes both:
   part-manifests into the final manifest and performs the commit
   rename, so a pod-wide checkpoint is still one atomic event.
 
-Telemetry rides ``mx.profiler``: counters ``checkpoint::save_seconds``,
-``checkpoint::bytes`` (cumulative) and ``checkpoint::pending`` (gauge)
-show up in ``profiler.dumps()``.
+Telemetry rides the unified ``mxnet_tpu.telemetry`` registry: counters
+``checkpoint::save_seconds``, ``checkpoint::bytes`` (cumulative) and
+``checkpoint::pending`` (gauge) show up in ``profiler.dumps()`` and in
+``telemetry.render_prometheus()``; snapshot/write/commit phases emit
+``checkpoint::*`` trace spans into the chrome-trace rings (suppressed
+in signal-handler mode).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -47,6 +51,8 @@ import time
 import zlib
 
 import numpy as np
+
+from ..telemetry import trace as _trace
 
 __all__ = ["CheckpointManager", "Shard", "CheckpointNotFoundError",
            "CheckpointCorruptError"]
@@ -339,7 +345,8 @@ class CheckpointManager:
         if self._closed:
             raise RuntimeError("CheckpointManager is closed")
         step = int(step)
-        snap = {k: _to_host(v) for k, v in _flatten(state).items()}
+        with self._span("checkpoint::snapshot", step=step):
+            snap = {k: _to_host(v) for k, v in _flatten(state).items()}
         if sync:
             self._write_with_retry(step, snap)
             return
@@ -516,8 +523,17 @@ class CheckpointManager:
                 time.sleep(delay)
                 delay *= 2
 
+    def _span(self, name, **args):
+        """Trace span, skipped in signal-handler (_quiet) mode — a
+        ring's first-use registration takes a lock the interrupted frame
+        could hold."""
+        if self._quiet:
+            return contextlib.nullcontext()
+        return _trace.span(name, **args)
+
     def _write_once(self, step, snap):
-        with self._fs_lock:
+        with self._fs_lock, \
+                self._span("checkpoint::write", step=step):
             t0 = time.perf_counter()
             final = self._step_dir(step)
             replace_torn = False
@@ -562,15 +578,18 @@ class CheckpointManager:
                 # the broken commit (worst case: a crash here leaves the
                 # tmp dir, and restore falls back exactly as before).
                 shutil.rmtree(final, ignore_errors=True)
-            try:
-                _rename(tmp, final)
-            except OSError:
-                if os.path.isfile(os.path.join(final, "manifest.json")):
-                    shutil.rmtree(tmp, ignore_errors=True)  # lost a race
-                else:
-                    raise
-            if self.fsync != "none":
-                _fsync_dir(self.directory)
+            with self._span("checkpoint::commit", step=step):
+                try:
+                    _rename(tmp, final)
+                except OSError:
+                    if os.path.isfile(os.path.join(final,
+                                                   "manifest.json")):
+                        # lost a race
+                        shutil.rmtree(tmp, ignore_errors=True)
+                    else:
+                        raise
+                if self.fsync != "none":
+                    _fsync_dir(self.directory)
             self._account(t0, written + len(blob))
             self._gc()
 
@@ -670,23 +689,15 @@ class CheckpointManager:
         return merged
 
     def _bump(self, counter, delta):
-        """Best-effort profiler counter update that NEVER blocks: the
-        profiler's global lock may be held by the very main-thread frame
-        a preemption signal interrupted, and a checkpoint thread
-        blocking on it while holding _fs_lock would deadlock the
-        handler's final save. Under contention (or _quiet) the telemetry
-        tick is dropped — the authoritative totals live on the manager."""
+        """Best-effort counter update that NEVER blocks: the registry
+        child's lock may be held by the very frame a preemption signal
+        interrupted, and a checkpoint thread blocking on it while
+        holding _fs_lock would deadlock the handler's final save. Under
+        contention (or _quiet) the telemetry tick is dropped — the
+        authoritative totals live on the manager."""
         if self._quiet:
             return
-        from .. import profiler
-
-        if profiler._lock.acquire(blocking=False):
-            try:
-                key = counter._key()
-                profiler._counters[key] = \
-                    profiler._counters.get(key, 0) + delta
-            finally:
-                profiler._lock.release()
+        counter._child.inc_try(delta)
 
     def _warn(self, msg):
         """log.warning, except in signal-handler (_quiet) mode where the

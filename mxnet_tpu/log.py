@@ -7,9 +7,11 @@ from __future__ import annotations
 
 import logging
 import sys
+import threading
+import time
 
-__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING",
-           "ERROR", "NOTSET"]
+__all__ = ["get_logger", "getLogger", "warn_rate_limited", "DEBUG",
+           "INFO", "WARNING", "ERROR", "NOTSET"]
 
 DEBUG = logging.DEBUG
 INFO = logging.INFO
@@ -57,6 +59,31 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     logger.addHandler(handler)
     logger.setLevel(level)
     return logger
+
+
+_rate_lock = threading.Lock()
+_rate_last = {}     # key -> last-emit time
+
+
+def warn_rate_limited(logger, key, interval_s, msg, *args, now=None):
+    """Emit ``logger.warning(msg, *args)`` at most once per
+    ``interval_s`` seconds per ``key``; suppressed repeats are counted
+    and reported on the next emitted line. Used by the telemetry
+    step-health monitor so an anomaly storm (every step suddenly slow)
+    warns once per window instead of flooding the log. ``now`` injects a
+    clock for tests (default ``time.monotonic``). Returns True when the
+    warning was emitted."""
+    t = time.monotonic() if now is None else now
+    with _rate_lock:
+        last, suppressed = _rate_last.get(key, (None, 0))
+        if last is not None and t - last < interval_s:
+            _rate_last[key] = (last, suppressed + 1)
+            return False
+        _rate_last[key] = (t, 0)
+    if suppressed:
+        msg = msg + " (+%d suppressed since last report)" % suppressed
+    logger.warning(msg, *args)
+    return True
 
 
 def getLogger(name=None, filename=None, filemode=None, level=WARNING):

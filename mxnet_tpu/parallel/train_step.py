@@ -16,6 +16,8 @@ aliasing).
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 import jax
@@ -23,12 +25,24 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random
+from ..telemetry import metrics as _tm
+from ..telemetry import trace as _trace
 from ..ndarray.ndarray import NDArray
 from ..gluon.parameter import override
 from .mesh import make_mesh, data_sharding, replicate, shard_params, \
     NamedSharding, P
 
 __all__ = ["TrainStep"]
+
+# Step-path telemetry: dispatch-side wall time per __call__ (the device
+# truth for the fused step lives in the XPlane trace — under async
+# dispatch this histogram measures what the HOST pays per step, which
+# is exactly what the <=2% bench overhead contract bounds).
+_step_seconds = _tm.REGISTRY.histogram(
+    "mx_train_step_seconds",
+    "TrainStep.__call__ wall time (host dispatch path)")
+_steps_total = _tm.REGISTRY.counter(
+    "mx_train_steps_total", "Completed TrainStep calls")
 
 
 def _as_pair(res):
@@ -568,6 +582,7 @@ class TrainStep:
         assembled across processes, exactly how each reference worker
         feeds its own `num_parts`/`part_index` shard of the epoch.
         """
+        t_start = time.perf_counter()
         if isinstance(x, NDArray):
             x = x._data
         if isinstance(y, NDArray):
@@ -576,19 +591,24 @@ class TrainStep:
             self._materialize(np.asarray(x)[:1])
         if self._jitted is None:
             self._build()
-        if self._multiproc:
-            x = jax.make_array_from_process_local_data(
-                self._data_sharding, np.asarray(x))
-            y = jax.make_array_from_process_local_data(
-                self._data_sharding, np.asarray(y))
-        else:
-            x = jax.device_put(jnp.asarray(x), self._data_sharding)
-            y = jax.device_put(jnp.asarray(y), self._data_sharding)
+        with _trace.span("train_step::data_put"):
+            if self._multiproc:
+                x = jax.make_array_from_process_local_data(
+                    self._data_sharding, np.asarray(x))
+                y = jax.make_array_from_process_local_data(
+                    self._data_sharding, np.asarray(y))
+            else:
+                x = jax.device_put(jnp.asarray(x), self._data_sharding)
+                y = jax.device_put(jnp.asarray(y), self._data_sharding)
         t = self.num_update + 1
         key = _random.next_key()
-        new_p, new_s, new_a, loss = self._jitted(
-            self._param_vals, self._opt_state, self._aux_vals, x, y,
-            jnp.float32(self.lr), jnp.float32(t), key)
+        # The dispatch span covers fwd+bwd+grad-sync+update as one fused
+        # executable; grad-sync is the psum XLA inserted inside it, so
+        # its device-side cost is only separable in the XPlane trace.
+        with _trace.span("train_step::dispatch", step=t):
+            new_p, new_s, new_a, loss = self._jitted(
+                self._param_vals, self._opt_state, self._aux_vals, x, y,
+                jnp.float32(self.lr), jnp.float32(t), key)
         # Single-bytecode commit of everything a checkpoint reads: a
         # signal handler (checkpoint.PreemptionHook) can interrupt
         # between any two statements here, and snapshotting params from
@@ -598,6 +618,10 @@ class TrainStep:
         self._param_vals, self._opt_state, self._aux_vals = \
             new_p, new_s, new_a
         self.num_update = t
+        t_end = time.perf_counter()
+        _trace.complete("train_step::step", t_start, t_end, step=t)
+        _step_seconds.observe(t_end - t_start)
+        _steps_total.inc()
         if self._multiproc:
             # The replicated loss is not fully addressable from one
             # controller; hand back this process's local replica so the

@@ -12,21 +12,34 @@ TPU rebuild, two layers:
    starts a trace capture whose output (TensorBoard/XPlane format, the
    modern chrome-trace equivalent; profiler.h:87 wrote chrome JSON)
    lands in the configured directory with per-HLO device timing.
-2. Framework level — the dispatch path records per-op wall-time spans
-   (op name, count, total/min/max) whenever profiling is on, feeding
-   `dumps()` aggregate tables like the reference's AggregateStats. On an
-   async backend these measure *dispatch* cost, not device cost — the
-   device truth lives in the trace files; both are stated in the output
-   header.
+2. Framework level — a thin VIEW over `mxnet_tpu.telemetry.REGISTRY`:
+   the dispatch path records per-op wall-time spans into the
+   ``mx_dispatch_seconds`` histogram family (exact count/total/min/max
+   per op), user-defined Counters live in the ``mx_profiler_counter``
+   gauge family, and Task/Frame/Marker events go to the bounded
+   ``telemetry.trace`` rings (no unbounded event log; ``dump()`` flushes
+   them to ``chrome_trace.json``). `dumps()` renders the same aggregate
+   tables as before — but serving, checkpoint and training metrics now
+   share the registry, so one `telemetry.render_prometheus()` (or the
+   /metrics endpoint) exposes everything this module shows and more.
 
-User-defined objects (Domain/Task/Frame/Counter/Marker) record into the
-same framework-level event log.
+On an async backend the op spans measure *dispatch* cost, not device
+cost — the device truth lives in the trace files; both are stated in
+the output header.
+
+Reset semantics (pinned by tests/test_profiler.py): ``dumps(reset=True)``
+clears the per-op dispatch statistics only. User-defined Counters are
+live process-global gauges (`checkpoint::pending`, `serving::requests`)
+shared across subsystems — they survive reset by design.
 """
 from __future__ import annotations
 
 import os
 import time
 import threading
+
+from .telemetry import metrics as _tm
+from .telemetry import trace as _trace
 
 __all__ = ["set_config", "profiler_set_config", "set_state",
            "profiler_set_state", "pause", "resume", "dump", "dumps",
@@ -41,10 +54,23 @@ _state = {
                "profile_api": True, "aggregate_stats": True},
     "trace_active": False,
 }
+# Kept for back-compat with callers that serialized on the profiler
+# lock; the registry families shard their own locks now.
 _lock = threading.Lock()
-_op_stats = {}       # name -> [count, total_s, min_s, max_s]
-_counters = {}       # (domain, name) -> value
-_events = []         # (timestamp, kind, name, info)
+
+# THE registry families behind this module's tables. Op spans keep
+# exact min/max (the histogram tracks extrema beside its exponential
+# buckets), so the aggregate table is bit-identical to the old one.
+_dispatch = _tm.REGISTRY.histogram(
+    "mx_dispatch_seconds",
+    "Framework-level dispatch spans per op (dispatch cost, not device "
+    "cost — device timing lives in the jax.profiler trace)",
+    labels=("op",))
+_user_counters = _tm.REGISTRY.gauge(
+    "mx_profiler_counter",
+    "User-defined profiler counters (profiler.Domain/Counter), named "
+    "domain::counter",
+    labels=("name",))
 
 
 _kv_handle = [None]
@@ -141,24 +167,28 @@ def is_recording():
 
 def record_op_span(name, seconds):
     """Called from the dispatch path for each op while profiling."""
-    with _lock:
-        st = _op_stats.get(name)
-        if st is None:
-            _op_stats[name] = [1, seconds, seconds, seconds]
-        else:
-            st[0] += 1
-            st[1] += seconds
-            st[2] = min(st[2], seconds)
-            st[3] = max(st[3], seconds)
+    _dispatch.labels(op=name).observe(seconds)
 
 
 def dump(finished=True, profile_process="worker"):
-    """Flush the device trace to disk (reference profiler.py:dump). The
-    jax trace is written at stop; dump() stops if still running."""
+    """Flush profile output (reference profiler.py:dump): writes the
+    framework span rings to ``<trace_dir>/chrome_trace.json`` and, when
+    ``finished`` (the default, reference semantics), stops the device
+    trace too — the profiler is done. ``finished=False`` flushes a
+    snapshot but keeps the profiler running and usable, so a long job
+    can dump mid-flight. A no-op when profiling was never started
+    (historical behavior — defensive teardown dumps leave no files)."""
     if profile_process == "server":
         _server_cmd("dump")
         return
-    if _state["running"]:
+    if not _state["running"]:
+        return      # nothing captured — keep the historical no-op
+    try:
+        os.makedirs(_trace_dir(), exist_ok=True)
+        _trace.dump(os.path.join(_trace_dir(), "chrome_trace.json"))
+    except OSError:
+        pass    # trace flush is best-effort; the device trace matters more
+    if finished:
         set_state("stop")
 
 
@@ -169,48 +199,70 @@ def server_dumps():
     return _server_cmd("dumps")
 
 
+def _op_table(reset=False):
+    """{op: (calls, total_s, min_s, max_s)} from the dispatch family.
+    With ``reset`` the family is drained (swap under the family lock)
+    before reading; at most one in-flight span per recorder thread can
+    fall between the snapshot and the fresh generation — the price of
+    not serializing every dispatch-path observe behind a global lock."""
+    items = _dispatch.drain() if reset else _dispatch.collect()
+    out = {}
+    for (name,), child in items:
+        snap = child.snapshot()
+        if snap["count"]:
+            out[name] = (snap["count"], snap["sum"], snap["min"],
+                         snap["max"])
+    return out
+
+
+def _counter_table():
+    """{'domain::name': value} from the user-counter family."""
+    return {name: child.value
+            for (name,), child in _user_counters.collect()}
+
+
 def dumps(reset=False, format="table"):
     """Aggregate statistics (reference profiler.py:dumps over
     aggregate_stats.cc). ``format='table'`` renders the human-readable
     table (reference behavior); ``format='json'`` returns the same data
     machine-readable — {"trace_dir", "ops": {name: {calls, total_ms,
     min_ms, max_ms}}, "counters": {"domain::name": value}} — for the
-    bench harness and serving dashboards."""
+    bench harness and serving dashboards.
+
+    ``reset=True`` clears the per-op dispatch statistics. User-defined
+    Counters are NOT reset: they are live gauges shared process-wide
+    (checkpoint::pending, serving::requests) and zeroing them here would
+    corrupt other subsystems' telemetry (behavior pinned by
+    tests/test_profiler.py::test_dumps_reset_keeps_counters)."""
     if format not in ("table", "json"):
         raise ValueError("format must be 'table' or 'json', got %r"
                          % (format,))
+    ops = _op_table(reset=reset)
+    counters = _counter_table()
     if format == "json":
         import json
 
-        with _lock:
-            payload = {
-                "trace_dir": _trace_dir(),
-                "ops": {name: {"calls": st[0], "total_ms": st[1] * 1e3,
-                               "min_ms": st[2] * 1e3, "max_ms": st[3] * 1e3}
-                        for name, st in _op_stats.items()},
-                "counters": {"%s::%s" % k: v
-                             for k, v in _counters.items()},
-            }
-            if reset:
-                _op_stats.clear()
-            return json.dumps(payload)
-    with _lock:
-        lines = [
-            "Profile Statistics (framework dispatch spans; device timing "
-            "is in the trace directory %r)" % _trace_dir(),
-            "%-40s %10s %14s %14s %14s" % ("Name", "Calls", "Total(ms)",
-                                           "Min(ms)", "Max(ms)"),
-        ]
-        for name in sorted(_op_stats):
-            cnt, tot, mn, mx = _op_stats[name]
-            lines.append("%-40s %10d %14.3f %14.3f %14.3f"
-                         % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3))
-        for (dom, name), val in sorted(_counters.items()):
-            lines.append("%-40s %10s %14s" % ("%s::%s" % (dom, name),
-                                              "counter", val))
-        if reset:
-            _op_stats.clear()
-        return "\n".join(lines)
+        return json.dumps({
+            "trace_dir": _trace_dir(),
+            "ops": {name: {"calls": st[0], "total_ms": st[1] * 1e3,
+                           "min_ms": st[2] * 1e3, "max_ms": st[3] * 1e3}
+                    for name, st in ops.items()},
+            "counters": counters,
+        })
+    lines = [
+        "Profile Statistics (framework dispatch spans; device timing "
+        "is in the trace directory %r)" % _trace_dir(),
+        "%-40s %10s %14s %14s %14s" % ("Name", "Calls", "Total(ms)",
+                                       "Min(ms)", "Max(ms)"),
+    ]
+    for name in sorted(ops):
+        cnt, tot, mn, mx = ops[name]
+        lines.append("%-40s %10d %14.3f %14.3f %14.3f"
+                     % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3))
+    for name in sorted(counters):
+        lines.append("%-40s %10s %14s" % (name, "counter",
+                                          counters[name]))
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
@@ -238,6 +290,11 @@ class Domain:
 
 
 class _Span:
+    """Task/Frame base: start/stop records one bounded trace-ring span
+    (flushed to chrome_trace.json by dump()) and, while profiling, an
+    aggregate dispatch row. No unbounded event log — the old module-wide
+    `_events` list grew forever and was appended without a lock."""
+
     def __init__(self, domain, name):
         self.domain = domain
         self.name = name
@@ -245,13 +302,14 @@ class _Span:
 
     def start(self):
         self._t0 = time.perf_counter()
-        _events.append((self._t0, "start", self._qual(), None))
 
     def stop(self):
         t1 = time.perf_counter()
-        _events.append((t1, "stop", self._qual(), None))
-        if self._t0 is not None and is_recording():
-            record_op_span(self._qual(), t1 - self._t0)
+        if self._t0 is not None:
+            _trace.complete(self._qual(), self._t0, t1)
+            if is_recording():
+                record_op_span(self._qual(), t1 - self._t0)
+            self._t0 = None
 
     def _qual(self):
         return "%s::%s" % (self.domain.name, self.name)
@@ -273,9 +331,16 @@ class Frame(_Span):
 
 
 class Counter:
+    """A named value in the unified registry (gauge semantics: set or
+    increment). Visible in dumps() as 'domain::name' AND in
+    telemetry.render_prometheus() as
+    mx_profiler_counter{name="domain::name"}."""
+
     def __init__(self, domain, name, value=None):
         self.domain = domain
         self.name = name
+        self._child = _user_counters.labels(
+            name="%s::%s" % (domain.name, name))
         if value is not None:
             self.set_value(value)
 
@@ -283,18 +348,16 @@ class Counter:
         return (self.domain.name, self.name)
 
     def set_value(self, value):
-        with _lock:
-            _counters[self._key()] = value
+        self._child.set(value)
 
     def increment(self, delta=1):
-        # Under _lock: serving worker/client threads increment while
-        # dumps() iterates _counters; unlocked read-modify-write would
-        # also lose concurrent increments.
-        with _lock:
-            _counters[self._key()] = _counters.get(self._key(), 0) + delta
+        # The registry child carries its own lock: serving worker/client
+        # threads increment while dumps() snapshots, and an unlocked
+        # read-modify-write would lose concurrent increments.
+        self._child.inc(delta)
 
     def decrement(self, delta=1):
-        self.increment(-delta)
+        self._child.inc(-delta)
 
     def __iadd__(self, v):
         self.increment(v)
@@ -311,8 +374,9 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
-        _events.append((time.perf_counter(), "marker",
-                        "%s::%s" % (self.domain.name, self.name), scope))
+        # Bounded trace-ring instant, not an unbounded list append.
+        _trace.instant("%s::%s" % (self.domain.name, self.name),
+                       scope=scope)
 
 
 # Reference env_var.md MXNET_PROFILER_AUTOSTART: begin profiling at import.

@@ -22,6 +22,7 @@ from collections import deque
 from concurrent.futures import Future
 
 from .admission import DeadlineExceededError
+from ..telemetry import trace as _trace
 
 __all__ = ["DynamicBatcher"]
 
@@ -126,7 +127,9 @@ class DynamicBatcher:
                 self._metrics.record_shed("queue_full")
                 raise
             self._q.append(req)
+            depth = len(self._q)
             self._cond.notify_all()
+        _trace.instant("serving::enqueue", rows=rows, depth=depth)
         return req.future
 
     @property

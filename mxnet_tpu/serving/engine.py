@@ -26,6 +26,7 @@ import numpy as np
 from .. import ndarray as nd
 from ..cached_op import CachedOp
 from ..ndarray.ndarray import NDArray
+from ..telemetry import trace as _trace
 from .admission import AdmissionController
 from .batcher import DynamicBatcher
 from .buckets import BucketPolicy
@@ -240,11 +241,19 @@ class InferenceServer:
             batch[off:off + req.rows] = req.data
             spans.append((req, off, off + req.rows))
             off += req.rows
+        # Dispatch marks the end of each request's queue wait — emitted
+        # retroactively so one Perfetto track shows queue wait vs device
+        # time per request.
+        for req in requests:
+            _trace.complete("serving::queue_wait", req.t_submit, t0,
+                            rows=req.rows, bucket=bucket)
         with self._model_lock:
-            out = self._model(nd.array(batch, ctx=self._ctx))
-            outs = out if isinstance(out, tuple) else (out,)
-            for o in outs:
-                o.wait_to_read()  # latency truth under async dispatch
+            with _trace.span("serving::device", bucket=bucket, rows=off,
+                             requests=len(requests)):
+                out = self._model(nd.array(batch, ctx=self._ctx))
+                outs = out if isinstance(out, tuple) else (out,)
+                for o in outs:
+                    o.wait_to_read()  # latency truth under async dispatch
         self.metrics.record_batch(bucket, off, len(requests),
                                   time.perf_counter() - t0)
         done = time.perf_counter()
@@ -252,4 +261,6 @@ class InferenceServer:
             sliced = tuple(o[i0:i1] for o in outs)
             self.metrics.record_request_latency(bucket,
                                                 done - req.t_submit)
+            _trace.complete("serving::request", req.t_submit, done,
+                            rows=req.rows, bucket=bucket)
             req.future.set_result(sliced if len(sliced) > 1 else sliced[0])

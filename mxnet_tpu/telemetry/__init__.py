@@ -1,0 +1,67 @@
+"""mxnet_tpu.telemetry — the framework-wide observability subsystem.
+
+Three pillars (ISSUE 3; reference identity: src/profiler/profiler.h's
+chrome-trace spans + aggregate tables, grown to production scope):
+
+1. **Metrics registry** (:mod:`.metrics`) — typed Counter / Gauge /
+   Histogram families with labels, lock-sharded for the step hot path,
+   exposed via ``render_prometheus()`` and the stdlib
+   ``start_http_server()`` ``/metrics`` endpoint. ``profiler.dumps()``,
+   ``serving`` stats and ``checkpoint`` counters are all views over the
+   single process-wide ``REGISTRY``.
+2. **Structured tracing** (:mod:`.trace`) — thread-aware span recording
+   (``with trace.span("step", step=i):``) into bounded per-thread
+   rings, flushed to chrome://tracing JSON (``trace.dump()``) loadable
+   in Perfetto alongside jax.profiler's XPlane capture. Spans are
+   emitted at every layer seam: CachedOp trace/execute, TrainStep
+   step/dispatch, serving enqueue→device→reply, checkpoint
+   snapshot/write/commit.
+3. **Step-health monitor** (:mod:`.health`) — rolling step-time EWMA
+   with slow-step outlier detection, recompile detection via the
+   ``CachedOp.on_trace`` hook, and checkpoint-writer backlog watching,
+   emitting rate-limited warnings and the ``mx_anomalies_total``
+   counter.
+
+Quick start::
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import trace
+
+    telemetry.start_http_server(9090)         # curl :9090/metrics
+    monitor = telemetry.StepMonitor()
+    for i in range(num_steps):
+        with monitor.step(i):
+            loss = train_step(x, y)
+    trace.dump("chrome_trace.json")           # load in Perfetto
+    print(telemetry.render_prometheus())
+
+``telemetry.set_enabled(False)`` pauses both metric recording and span
+capture (the bench.py ``telemetry_step_overhead_pct`` contract measures
+the difference: <= 2% on the step path).
+"""
+from __future__ import annotations
+
+from . import metrics
+from . import trace
+from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
+                      render_prometheus, start_http_server,
+                      default_buckets)
+from .health import StepMonitor
+
+__all__ = ["metrics", "trace", "Registry", "REGISTRY", "counter",
+           "gauge", "histogram", "render_prometheus",
+           "start_http_server", "default_buckets", "StepMonitor",
+           "set_enabled", "enabled"]
+
+
+def set_enabled(on):
+    """Master switch for the whole subsystem: gates metric recording AND
+    span capture. Returns the previous combined state."""
+    prev = metrics.enabled() and trace.enabled()
+    metrics.set_enabled(on)
+    trace.set_enabled(on)
+    return prev
+
+
+def enabled():
+    return metrics.enabled() and trace.enabled()

@@ -1,0 +1,205 @@
+"""mxnet_tpu.telemetry.trace — structured span recording to
+chrome://tracing JSON.
+
+The reference profiler wrote chrome-trace JSON spans straight from the
+engine (src/profiler/profiler.h:87); here the device truth lives in
+jax.profiler's XPlane output, and THIS module records the *framework*
+seams — CachedOp trace/execute, TrainStep step/dispatch, serving
+enqueue→device→reply, checkpoint snapshot/write/commit — so one
+Perfetto load shows queue wait next to device time.
+
+Design:
+
+* **Per-thread bounded rings.** Each recording thread appends tuples to
+  its own ``deque(maxlen=capacity)`` (GIL-atomic, no lock on the hot
+  path; the global lock is taken once per thread, at ring creation).
+  Memory is bounded by construction — a long-running server keeps the
+  last ``capacity`` events per thread and silently drops the oldest,
+  and rings of dead threads are pruned (newest ``_MAX_DEAD_RINGS``
+  retained so short-lived helpers' events survive until the next
+  flush), so thread churn cannot grow the registry without bound.
+* **Complete events.** Spans are emitted at exit as one chrome ``"X"``
+  (complete) event with ``ts``/``dur`` in microseconds; ``instant()``
+  emits ``"i"`` markers; ``complete()`` emits retroactive spans from
+  explicit perf-counter timestamps (how the serving worker backfills a
+  request's queue-wait once it knows when dispatch started).
+* **Flush, don't stream.** ``chrome_trace()`` merges the rings into a
+  ``{"traceEvents": [...]}`` dict; ``dump(path)`` writes it as JSON
+  loadable in Perfetto / chrome://tracing alongside the XPlane capture.
+
+``set_enabled(False)`` turns ``span()`` bodies into no-ops (one boolean
+check) — the tracing half of the telemetry overhead contract.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = ["span", "instant", "complete", "chrome_trace", "dump",
+           "clear", "set_enabled", "enabled", "set_capacity", "capacity",
+           "event_count"]
+
+_DEFAULT_CAPACITY = 16384
+# Rings of dead threads retained for the next flush (most recent first
+# to go): keeps short-lived helpers' events dumpable while bounding the
+# registry under thread churn (a thread-per-request server must not
+# accumulate one ring per connection forever).
+_MAX_DEAD_RINGS = 32
+
+_state = {"enabled": True, "capacity": _DEFAULT_CAPACITY}
+_registry_lock = threading.Lock()
+_rings = []            # [(thread, deque), ...]
+_tls = threading.local()
+
+
+def set_enabled(on):
+    """Enable/disable span recording; returns the previous state."""
+    prev = _state["enabled"]
+    _state["enabled"] = bool(on)
+    return prev
+
+
+def enabled():
+    return _state["enabled"]
+
+
+def set_capacity(n):
+    """Per-thread ring capacity for rings created AFTER this call
+    (existing rings keep their bound — they are owned by their threads
+    and cannot be swapped safely)."""
+    _state["capacity"] = int(n)
+
+
+def capacity():
+    return _state["capacity"]
+
+
+def _prune_locked():
+    """Drop the oldest dead-thread rings beyond _MAX_DEAD_RINGS (caller
+    holds _registry_lock). Live threads' rings are never dropped."""
+    dead = [entry for entry in _rings if not entry[0].is_alive()]
+    for entry in dead[:-_MAX_DEAD_RINGS] if _MAX_DEAD_RINGS else dead:
+        _rings.remove(entry)
+
+
+def _ring():
+    ring = getattr(_tls, "ring", None)
+    if ring is None:
+        thread = threading.current_thread()
+        ring = deque(maxlen=_state["capacity"])
+        with _registry_lock:
+            _prune_locked()
+            _rings.append((thread, ring))
+        _tls.ring = ring
+    return ring
+
+
+class _Span:
+    """Context manager recording one complete event on exit. Cheap when
+    tracing is disabled: no clock read, no ring append."""
+
+    __slots__ = ("_name", "_args", "_t0")
+
+    def __init__(self, name, args):
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter() if _state["enabled"] else None
+        return self
+
+    def __exit__(self, *exc):
+        t0 = self._t0
+        if t0 is not None:
+            t1 = time.perf_counter()
+            _ring().append(("X", self._name, t0 * 1e6, (t1 - t0) * 1e6,
+                            self._args))
+        return False
+
+
+def span(name, **args):
+    """``with trace.span("step", step=i): ...`` — records a chrome
+    complete event covering the block (thread-local ring)."""
+    return _Span(name, args or None)
+
+
+def instant(name, **args):
+    """Zero-duration marker event."""
+    if _state["enabled"]:
+        _ring().append(("i", name, time.perf_counter() * 1e6, 0,
+                        args or None))
+
+
+def complete(name, start_s, end_s, **args):
+    """Retroactive span from explicit ``time.perf_counter()`` seconds —
+    lets a worker emit e.g. a request's queue-wait after the fact."""
+    if _state["enabled"]:
+        _ring().append(("X", name, start_s * 1e6,
+                        max(0.0, end_s - start_s) * 1e6, args or None))
+
+
+def event_count():
+    """Total buffered events across every thread ring."""
+    with _registry_lock:
+        rings = [r for _, r in _rings]
+    return sum(len(r) for r in rings)
+
+
+def clear():
+    """Drop buffered events (live threads' rings stay registered; dead
+    threads' rings are released)."""
+    with _registry_lock:
+        _rings[:] = [entry for entry in _rings if entry[0].is_alive()]
+        rings = [r for _, r in _rings]
+    for r in rings:
+        r.clear()
+
+
+def _snapshot(ring):
+    # A bounded deque mutated concurrently can raise during iteration;
+    # events are telemetry, so retry a couple of times and settle for
+    # whatever copies cleanly.
+    for _ in range(4):
+        try:
+            return list(ring)
+        except RuntimeError:
+            continue
+    return []
+
+
+def chrome_trace():
+    """Merge every thread ring into a chrome://tracing
+    ``{"traceEvents": [...]}`` dict (trace-event JSON array format, the
+    one Perfetto and chrome://tracing both load). Each event carries
+    ``ph``/``name``/``ts``/``pid``/``tid`` (+ ``dur`` for complete
+    events); thread-name metadata events label the tracks."""
+    pid = os.getpid()
+    events = []
+    with _registry_lock:
+        rings = list(_rings)
+    for thread, ring in rings:
+        tid = thread.ident or 0
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "ts": 0, "args": {"name": thread.name}})
+        for ph, name, ts, dur, args in _snapshot(ring):
+            event = {"ph": ph, "name": name, "pid": pid, "tid": tid,
+                     "ts": ts}
+            if ph == "X":
+                event["dur"] = dur
+            elif ph == "i":
+                event["s"] = "t"   # instant scope: thread
+            if args:
+                event["args"] = dict(args)
+            events.append(event)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def dump(path="chrome_trace.json"):
+    """Write ``chrome_trace()`` to ``path``; returns the path."""
+    data = chrome_trace()
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return path

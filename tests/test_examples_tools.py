@@ -36,6 +36,23 @@ def test_train_mnist_synthetic():
     assert acc > 0.8, "mnist driver accuracy %.3f" % acc
 
 
+def test_train_telemetry_example(tmp_path):
+    """README Observability snippet: TelemetryCallback + StepMonitor in
+    a TrainStep loop, chrome-trace capture, prometheus exposition."""
+    import json
+
+    out = _run([sys.executable, "examples/train_telemetry.py",
+                "--num-batches", "12", "--batch-size", "32",
+                "--out-dir", str(tmp_path)])
+    assert "telemetry demo ok" in out
+    assert "mx_train_steps_total 12" in out
+    with open(os.path.join(str(tmp_path), "chrome_trace.json")) as f:
+        events = json.load(f)["traceEvents"]
+    names = {e["name"] for e in events}
+    assert any(n.startswith("train_step::") for n in names), names
+    assert any(n.startswith("checkpoint::") for n in names), names
+
+
 def test_train_imagenet_benchmark_mode():
     out = _run([sys.executable, "examples/train_imagenet.py",
                 "--benchmark", "1", "--network", "resnet18",

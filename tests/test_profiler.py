@@ -88,6 +88,81 @@ def test_profiler_dumps_json_format():
         profiler.dumps(format="xml")
 
 
+def test_dumps_reset_keeps_counters():
+    """Pinned behavior (ISSUE 3 satellite): dumps(reset=True) clears the
+    per-op dispatch stats but NOT user-defined Counters — they are live
+    process-global gauges (checkpoint::pending, serving::requests)
+    shared across subsystems."""
+    import json
+
+    dom = profiler.Domain("resetpin")
+    dom.new_counter("kept", 11)
+    profiler.record_op_span("resetpin_op", 0.001)
+    payload = json.loads(profiler.dumps(format="json", reset=True))
+    assert payload["ops"]["resetpin_op"]["calls"] == 1
+    after = json.loads(profiler.dumps(format="json"))
+    assert "resetpin_op" not in after["ops"]
+    assert after["counters"]["resetpin::kept"] == 11
+    # the table path resets identically
+    profiler.record_op_span("resetpin_op", 0.001)
+    profiler.dumps(reset=True)
+    table = profiler.dumps()
+    assert "resetpin_op" not in table
+    assert "resetpin::kept" in table
+
+
+def test_dump_finished_false_keeps_profiler_usable():
+    """dump(finished=False) flushes a chrome-trace snapshot but leaves
+    the profiler running (reference semantics: the `finished` argument
+    was previously accepted and ignored); dump() with the default
+    finished=True stops it."""
+    import json
+
+    with tempfile.TemporaryDirectory() as d:
+        trace_dir = os.path.join(d, "prof")
+        profiler.set_config(filename=trace_dir)
+        profiler.set_state("run")
+        try:
+            mx.nd.ones((4, 4)).tanh().wait_to_read()
+            profiler.dump(finished=False)
+            assert profiler.is_recording()          # still usable
+            path = os.path.join(trace_dir, "chrome_trace.json")
+            assert os.path.isfile(path)
+            with open(path) as f:
+                data = json.load(f)
+            assert isinstance(data["traceEvents"], list)
+            mx.nd.ones((4, 4)).exp().wait_to_read() # records after dump
+            assert "exp" in profiler.dumps()
+            profiler.dump()                         # finished=True
+            assert not profiler.is_recording()
+        finally:
+            profiler.set_state("stop")
+        profiler.set_config(filename="profile_output")
+
+
+def test_profiler_events_bounded():
+    """Task/Frame/Marker events land in the bounded telemetry trace
+    rings — the old module-level `_events` list (appended without a lock
+    and never drained: a leak in any long-running server) is gone."""
+    from mxnet_tpu.telemetry import trace
+
+    assert not hasattr(profiler, "_events")
+    trace.clear()        # other suites' worker threads left events
+    dom = profiler.Domain("bounded")
+    marker = dom.new_marker("tick")
+    cap = trace.capacity()
+    for _ in range(cap + 500):
+        marker.mark()
+    # this thread's ring is full at cap; other registered (now idle)
+    # thread rings were cleared above, so the global count stays bounded
+    assert trace.event_count() <= cap
+    with dom.new_task("work"):
+        pass
+    names = [e["name"] for e in trace.chrome_trace()["traceEvents"]]
+    assert "bounded::tick" in names and "bounded::work" in names
+    trace.clear()
+
+
 def test_monitor_collects_stats():
     from mxnet_tpu.monitor import Monitor
 
