@@ -42,6 +42,13 @@ CATALOGUE = [
     Knob("MXNET_WORKER_START_METHOD", str, "fork", "gluon/data/dataloader.py",
          "DataLoader worker start method: fork | forkserver | spawn",
          False),
+    Knob("MXNET_FUSED_UPDATE", bool, True, "gluon/trainer.py",
+         "imperative fused update path: multi-tensor optimizer apply + "
+         "bucketed gradient aggregation (per-Trainer override: "
+         "fused=False)", False),
+    Knob("MXNET_FUSED_BUCKET_MB", int, 25, "fused_update.py",
+         "coalescing bucket size for fused gradient aggregation "
+         "(DDP-style; traffic scales with ceil(params/bucket))", False),
     Knob("MXNET_PROFILER_AUTOSTART", int, 0, "profiler.py",
          "start device+dispatch profiling at import", False),
     Knob("DMLC_ROLE", str, "worker", "kvstore_server.py",

@@ -76,6 +76,21 @@ class KVStore:
     def init(self, key, value):
         raise NotImplementedError
 
+    def contains(self, key):
+        """Whether `key` was initialized in this store. Conservative
+        default False for stores that don't track membership locally
+        (dist workers); the Trainer's lazy ``__fused_grad_bucket_*``
+        registration consults it before ``init`` so two trainers
+        sharing one local store don't double-init, and keeps its own
+        per-trainer key set as the fallback."""
+        return False
+
+    def discard(self, key):
+        """Drop `key`'s stored value if present (no-op default). Lets
+        the Trainer free a retired generation of coalesced gradient
+        buckets when the param-set signature drifts, instead of leaking
+        ~25MB flat buffers in the store for process lifetime."""
+
     def push(self, key, value, priority=0):
         raise NotImplementedError
 
@@ -142,6 +157,13 @@ class KVStoreLocal(KVStore):
     def type(self):
         return "device" if self._device_mode else "local"
 
+    def contains(self, key):
+        return key in self._store
+
+    def discard(self, key):
+        self._store.pop(key, None)
+        self._stype.pop(key, None)
+
     def init(self, key, value):
         keys, single = _key_list(key)
         vals = _val_list(value, len(keys), single)
@@ -158,7 +180,12 @@ class KVStoreLocal(KVStore):
         XLA schedule device-to-device moves; with a sharded global array
         this is a true ICI all-reduce (parallel/ path). row_sparse values
         merge by row concatenation + duplicate aggregation without
-        densifying (reference comm.h sparse Reduce)."""
+        densifying (reference comm.h sparse Reduce).
+
+        The fused Trainer path pushes coalesced flat buckets through
+        this same seam: summing a concatenation is element-for-element
+        the same add chain as summing each key separately, so bucketed
+        and per-key aggregation agree bitwise."""
         if isinstance(vlist[0], _sparse.RowSparseNDArray):
             import numpy as _np
 

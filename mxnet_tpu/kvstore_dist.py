@@ -249,6 +249,31 @@ class KVStoreDist(KVStoreLocal):
 
     # -- core API -------------------------------------------------------------
 
+    def contains(self, key):
+        return key in self._meta
+
+    def discard(self, key):
+        """Drop a key worker-side (`_meta`) AND server-side (rank 0
+        sends `delete` per shard) — the Trainer retires a generation of
+        coalesced gradient buckets through this when the param-set
+        signature drifts; without the server delete each drift would
+        leak a bucket-sized value per server for process lifetime."""
+        meta = self._meta.pop(key, None)
+        if meta is None:
+            return
+        shape, _, stype = meta
+        shards = self._shards(key, shape, stype)
+        if self._compression is not None:
+            # Error-feedback residuals are WORKER-local, one per shard
+            # subkey — every rank must drop its own or each retired
+            # generation leaks bucket-sized float buffers here too.
+            for _, subkey, _ in shards:
+                self._compression._residual.pop(subkey, None)
+        if self._rank == 0:
+            for sidx, subkey, _ in shards:
+                self._call(sidx, ("delete", subkey))
+        self._barrier()
+
     def init(self, key, value):
         """Rank 0 seeds the servers; everyone records shape metadata and a
         barrier makes the value visible before any worker proceeds

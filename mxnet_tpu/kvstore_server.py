@@ -441,6 +441,18 @@ class KVStoreServer:
             self._keys[msg[1]] = _KeyState(np.asarray(msg[2]))
             self._write_snapshot(msg[1])
             self._send(conn, ("ok",))
+        elif cmd == "delete":
+            # Retire a key (fused-trainer bucket-generation GC): drop
+            # the stored value and its recovery snapshot so the server
+            # neither leaks the buffer nor resurrects it on restart.
+            self._keys.pop(msg[1], None)
+            if self._snapshot_dir is not None and \
+                    self.server_id is not None:
+                try:
+                    os.remove(self._key_path(msg[1]))
+                except OSError:
+                    pass
+            self._send(conn, ("ok",))
         elif cmd in ("push", "push_compressed", "push_rsp"):
             key = msg[1]
             state = self._keys.get(key)

@@ -49,6 +49,52 @@ def load_checkpoint(prefix, epoch):
     return symbol, arg_params, aux_params
 
 
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   applier=None, merge_bufs=None):
+    """Local (non-kvstore) parameter update seam (reference
+    model.py:_update_params): merge per-device gradients, apply the
+    updater once on device 0, broadcast the result.
+
+    ``param_arrays``/``grad_arrays`` are per-parameter lists of
+    per-device NDArrays; a ``None`` entry skips that index (fixed
+    params). ``num_device`` is accepted for reference-signature parity
+    only — the device count is implied by the array lists, and
+    gradient normalization is the optimizer's ``rescale_grad``, never a
+    division here. With ``applier`` (a fused_update.FusedApplier) the
+    dense eligible updates run as one multi-tensor executable per
+    (ctx, dtype) group — same values as the per-index loop — and only
+    the remainder takes the per-param updater. ``merge_bufs`` (a dict
+    the caller owns) keeps the multi-device merged gradient in ONE
+    stable NDArray per index so the applier's identity-based plan
+    cache stays hot across steps."""
+    entries = []
+    for index, (weights, grads) in enumerate(zip(param_arrays,
+                                                 grad_arrays)):
+        if weights is None or grads is None or not grads:
+            continue
+        grad = grads[0]
+        if len(grads) > 1:
+            for g in grads[1:]:
+                grad = grad + g.as_in_context(grad.context)
+            if merge_bufs is not None:
+                buf = merge_bufs.get(index)
+                if buf is None:
+                    merge_bufs[index] = grad
+                else:
+                    buf._set_data(grad._data)
+                    grad = buf
+        entries.append((index, weights[0], grad))
+    pending = applier.apply(entries) if applier is not None else entries
+    for index, weight, grad in pending:
+        updater(index, grad, weight)
+    for index, (weights, grads) in enumerate(zip(param_arrays,
+                                                 grad_arrays)):
+        if weights is None or grads is None or not grads:
+            continue
+        for w in weights[1:]:
+            w[:] = weights[0].as_in_context(w.context)
+
+
 def _create_kvstore(kvstore, num_device, arg_params):
     """(reference model.py:_create_kvstore). Returns (kv,
     update_on_kvstore)."""

@@ -19,10 +19,11 @@ import logging
 import numpy as np
 
 from .. import context as ctx_mod
+from .. import env as _env
 from .. import ndarray as nd
 from .. import optimizer as opt
 from ..base import MXNetError
-from ..model import _create_kvstore, load_checkpoint
+from ..model import _create_kvstore, _update_params, load_checkpoint
 from ..ndarray.ndarray import NDArray
 from .base_module import BaseModule, _as_list
 
@@ -70,6 +71,11 @@ class Module(BaseModule):
         self._update_on_kvstore = False
         self._optimizer = None
         self._updater = None
+        # None until init_optimizer: shared-module paths (Bucketing/
+        # Sequential) that install an updater directly take the
+        # per-param loop.
+        self._fused_applier = None
+        self._merge_bufs = {}
         self._preload_opt_states = None
         self._grad_req = "write"
 
@@ -224,6 +230,14 @@ class Module(BaseModule):
             optimizer.idx2name = idx2name
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
+        # Fused multi-tensor apply for the local-update branch (same
+        # seam as gluon.Trainer; MXNET_FUSED_UPDATE=0 opts out).
+        if _env.get("MXNET_FUSED_UPDATE"):
+            from .. import fused_update as _fu
+
+            self._fused_applier = _fu.FusedApplier(self._updater)
+        else:
+            self._fused_applier = None
 
         kv, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), None)
@@ -310,18 +324,23 @@ class Module(BaseModule):
                 weights = [ex.arg_dict[name] for ex in self._execs]
                 self._kvstore.pull(i, out=weights)
         else:
-            for i, name in enumerate(self._param_names):
+            # Fixed params bind with grad_req null in the reference
+            # executor group; here they still allocate grads, so a None
+            # entry keeps the updater index stable while skipping them.
+            param_arrays, grad_arrays = [], []
+            for name in self._param_names:
                 if name in self._fixed_param_names:
+                    param_arrays.append(None)
+                    grad_arrays.append(None)
                     continue
-                grads = [ex.grad_dict[name] for ex in self._execs]
-                grad = grads[0]
-                for g in grads[1:]:
-                    grad = grad + g.as_in_context(grad.context)
-                weight = self._execs[0].arg_dict[name]
-                self._updater(i, grad, weight)
-                for other in self._execs[1:]:
-                    other.arg_dict[name][:] = weight.as_in_context(
-                        other.arg_dict[name].context)
+                param_arrays.append([ex.arg_dict[name]
+                                     for ex in self._execs])
+                grad_arrays.append([ex.grad_dict[name]
+                                    for ex in self._execs])
+            _update_params(param_arrays, grad_arrays, self._updater,
+                           len(self._execs),
+                           applier=self._fused_applier,
+                           merge_bufs=self._merge_bufs)
         # aux states: device 0 is authoritative, replicate
         for name in self._aux_names:
             a0 = self._execs[0].aux_dict[name]

@@ -18,7 +18,7 @@ import numpy as np
 from .ndarray import NDArray, array, zeros as _dense_zeros
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage", "zeros", "retain", "dot"]
+           "dense_to_rsp_device", "cast_storage", "zeros", "retain", "dot"]
 
 
 class BaseSparseNDArray(NDArray):
@@ -175,6 +175,43 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     nz_rows = np.where(np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
     return RowSparseNDArray(array(dense[nz_rows]), array(nz_rows, dtype="int64"),
                             dense.shape, ctx=ctx)
+
+
+def dense_to_rsp_device(arr):
+    """Dense NDArray → RowSparseNDArray with the nonzero-row extraction
+    on DEVICE — the hot-path replacement for
+    ``row_sparse_array(grad.asnumpy())``, which round-tripped the whole
+    gradient through host memory every step (gluon.Trainer row-sparse
+    update path).
+
+    The row count is padded to a power of two with OUT-OF-RANGE ids
+    (= num_rows), the `_rsp_rows` executable-cache trick: XLA clamps
+    out-of-bounds gathers and drops out-of-bounds scatters, so padded
+    lanes are exact no-ops and each power-of-two count reuses one
+    executable. Padded lanes hold clamped-gather garbage values, which
+    is fine precisely because every write through their ids is dropped
+    (todense / the lazy optimizer paths all go through ``.at[idx]``).
+
+    The only host traffic is ONE scalar (the nonzero-row count, needed
+    to pick the static pad size) — never the gradient payload. The
+    result is flagged ``_rows_ready`` so ``optimizer._rsp_rows`` skips
+    its host-side duplicate aggregation: rows of a dense gradient are
+    unique and ascending by construction.
+    """
+    import jax.numpy as jnp
+
+    data = arr._data
+    num_rows = data.shape[0]
+    mask = jnp.any(data != 0, axis=tuple(range(1, data.ndim)))
+    n = int(jnp.count_nonzero(mask))            # one scalar readback
+    bucket = 1 << max(n - 1, 0).bit_length() if n else 1
+    (idx,) = jnp.nonzero(mask, size=bucket, fill_value=num_rows)
+    vals = data[idx]                            # pad ids: clamped gather
+    out = RowSparseNDArray(NDArray(vals, ctx=arr.context),
+                           NDArray(idx, ctx=arr.context),
+                           tuple(arr.shape), ctx=arr.context)
+    out._rows_ready = True
+    return out
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):

@@ -27,6 +27,13 @@ __all__ = ["Operator", "register", "get", "list_all_ops", "invoke", "OP_REGISTRY
 
 OP_REGISTRY: dict[str, "Operator"] = {}
 
+# Executable launches since import — every imperative jitted dispatch
+# (invoke_raw's non-inlined path) plus the fused-update path's coalesced
+# launches (fused_update._dispatch) bump this. Traced-inline calls do
+# NOT count: they fuse into an enclosing executable instead of
+# launching one. Read through test_utils.count_dispatches().
+DISPATCHES = [0]
+
 
 def _freeze(value):
     """Make op attrs hashable so they can key the executable cache."""
@@ -165,6 +172,7 @@ def invoke_raw(op: Operator, arrays, attrs, named=()):
         # Inside an enclosing jit/vjp/vmap trace: inline so the whole
         # surrounding graph compiles as one executable.
         return op.bound_fn(attrs, named)(*arrays)
+    DISPATCHES[0] += 1
     if _profiler_mod is None:
         from .. import profiler as _profiler_mod_  # lazy, once
 

@@ -49,6 +49,13 @@ def _rsp_rows(grad):
 
     from .ndarray.sparse import _aggregate_rows_np
 
+    if getattr(grad, "_rows_ready", False):
+        # Device-prepped gradient (sparse.dense_to_rsp_device): rows are
+        # already unique, ascending, and pow2-padded with out-of-range
+        # ids — skip the host aggregation round trip entirely. This is
+        # the Trainer hot path; the host branch below remains for
+        # arbitrary user-built row_sparse gradients (duplicate ids).
+        return grad.indices._data, grad.data._data
     # Aggregate AND pad entirely on host, then upload once — an
     # aggregate-on-device detour would round-trip the indices
     # (upload → download → pad → re-upload) on the hot update path.

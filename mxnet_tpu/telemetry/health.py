@@ -145,6 +145,45 @@ class StepMonitor:
         cached_op.on_trace = _hook
         return cached_op
 
+    def attach_fused(self, applier, expected_compiles=None):
+        """Watch a fused_update.FusedApplier for recompile storms by
+        chaining onto its ``on_compile`` hook (the CachedOp ``on_trace``
+        pattern — the existing hook keeps firing; the same events also
+        land in ``mx_fused_apply_compiles_total``).
+
+        Only compiles AFTER the applier reached steady state count
+        against the budget (default ``expected_traces``): a large or
+        mixed-dtype model legitimately compiles one executable per
+        chunk/per (ctx, dtype) group on its first step, which is not a
+        storm. A post-warmup compile means the param-set signature
+        changed (shapes/dtypes/hyperparams churning between steps) —
+        that shows up here long before it shows up in step time.
+        Returns the applier so ``monitor.attach_fused(trainer._applier)``
+        composes."""
+        budget = self.expected_traces if expected_compiles is None \
+            else int(expected_compiles)
+        previous = applier.on_compile
+        state = {"compiles": 0}
+
+        def _hook(a):
+            if previous is not None:
+                previous(a)
+            if getattr(a, "_plan", None) is None:
+                # Initial build: the applier has never completed an
+                # apply, so these are the expected warmup compiles.
+                return
+            state["compiles"] += 1
+            if state["compiles"] > budget:
+                self._anomaly(
+                    "fused_recompile",
+                    "fused optimizer apply recompiled %d times after "
+                    "warmup (budget %d) — param-set signature churn "
+                    "(shapes/dtypes/hyperparams changing between steps)"
+                    % (state["compiles"], budget))
+
+        applier.on_compile = _hook
+        return applier
+
     def watch_checkpoint(self, manager):
         """Poll ``manager.pending`` at each observed step for writer
         backlog. Returns the manager."""

