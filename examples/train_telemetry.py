@@ -13,10 +13,19 @@ What this driver shows:
    through mxnet_tpu.log and count into `mx_anomalies_total`),
 3. async `checkpoint.CheckpointManager` saves whose `checkpoint::*`
    counters land in the SAME registry,
-4. `telemetry.trace.dump()` — a chrome_trace.json loadable in Perfetto
-   (chrome://tracing), spans from the train-step, serving and
-   checkpoint seams on their own thread tracks,
-5. `telemetry.render_prometheus()` — and, with `--metrics-port`, a live
+4. **streaming span export** — a `StreamingTraceWriter` drains the
+   trace rings incrementally into atomically committed
+   `trace.rank0.*.jsonl` segments (a kill mid-run keeps everything
+   committed so far), and `tools/trace_merge.py` stitches them into the
+   final chrome_trace.json loadable in Perfetto (chrome://tracing),
+5. **pod-style aggregation** — an `Aggregator` over a `LocalBus`
+   endpoint shows the fleet view: the final scrape carries every series
+   labeled by rank (here rank 0) plus the staleness gauges,
+6. **SLO burn rate** — a `BurnRateMonitor` over `mx_train_step_seconds`
+   emits `mx_slo_burn_rate{slo,window}` gauges,
+7. **flamegraph** — `profiler.dumps(format="top")` self-time table and
+   a collapsed-stack file for flamegraph.pl / speedscope,
+8. `telemetry.render_prometheus()` — and, with `--metrics-port`, a live
    stdlib `/metrics` endpoint to curl while it trains.
 
     python examples/train_telemetry.py --num-batches 40
@@ -78,8 +87,23 @@ def main():
 
     # -- telemetry wiring -----------------------------------------------------
     monitor = telemetry.StepMonitor(slow_factor=3.0, warmup_steps=3)
+    # Streaming export: spans hit disk incrementally (age budget keeps
+    # an observer at most 5s behind), committed atomically per segment.
+    writer = telemetry.StreamingTraceWriter(
+        os.path.join(out_dir, "trace_segments"), max_segment_age_s=5.0)
+    # Pod-style aggregation, single-process edition: a LocalBus stands
+    # in for the kvstore channel; the fleet scrape labels every series
+    # with its rank. On a real dist job pass the KVStoreDist instead.
+    bus = telemetry.aggregate.LocalBus(num_workers=1)
+    aggregator = telemetry.Aggregator(bus.endpoint(0), interval_s=2.0,
+                                      monitor=monitor)
+    # SLO: 95% of steps under 2s — generous on purpose; the burn-rate
+    # gauges still show the machinery live.
+    burn = telemetry.BurnRateMonitor(eval_interval_s=1.0)
+    burn.add_latency_slo("train_step", 0.95, 2.0, "mx_train_step_seconds")
     cb = callback.TelemetryCallback(args.batch_size, frequent=10,
-                                    monitor=monitor)
+                                    monitor=monitor, trace_writer=writer,
+                                    aggregator=aggregator, slo=burn)
     manager = CheckpointManager(os.path.join(out_dir, "ckpt"),
                                 keep_last=2)
     monitor.watch_checkpoint(manager)
@@ -95,29 +119,48 @@ def main():
                                locals=None))
     final_loss = float(np.asarray(loss))
     manager.close()
+    burn.evaluate()
+    aggregator.close()          # final push: fleet view is current
+    writer.close()              # final segment commit
 
-    # -- flush + report -------------------------------------------------------
-    trace_path = trace.dump(os.path.join(out_dir, "chrome_trace.json"))
-    text = telemetry.render_prometheus()
+    # -- merge + report -------------------------------------------------------
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import trace_merge
+
+    trace_path = os.path.join(out_dir, "chrome_trace.json")
+    merged = trace_merge.merge([os.path.join(out_dir, "trace_segments")],
+                               out=trace_path)
+    flame_path = telemetry.flamegraph.dump_collapsed(
+        os.path.join(out_dir, "flame.collapsed"), merged)
+
+    text = aggregator.render_prometheus()       # the fleet view
     interesting = [l for l in text.splitlines()
                    if l.startswith(("mx_train_steps_total",
                                     "mx_train_samples_total",
                                     "mx_train_step_seconds_count",
                                     "mx_cachedop_compiles_total",
-                                    "mx_anomalies_total"))
+                                    "mx_anomalies_total",
+                                    "mx_slo_burn_rate",
+                                    "mx_rank_stale"))
                    or 'name="checkpoint::' in l]
     print("\n".join(interesting))
     print("step-health: %s" % monitor.snapshot())
-    print("chrome trace: %s (load in Perfetto / chrome://tracing)"
-          % trace_path)
+    print(mx.profiler.dumps(format="top"))
+    print("chrome trace: %s (load in Perfetto / chrome://tracing); "
+          "%d streamed segments; collapsed stacks: %s"
+          % (trace_path, len(writer.committed), flame_path))
     print("final loss %.4f" % final_loss)
 
     steps_total = telemetry.REGISTRY.get("mx_train_steps_total").value
     ok = (steps_total >= args.num_batches
           and os.path.getsize(trace_path) > 0
+          and len(writer.committed) >= 1
+          and 'rank="0"' in text
           and "mx_train_step_seconds_count" in text)
     if server is not None:
-        server.shutdown()
+        server.close()
     print("telemetry demo %s" % ("ok" if ok else "FAILED"))
     return 0 if ok else 1
 

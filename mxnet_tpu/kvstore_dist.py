@@ -445,6 +445,23 @@ class KVStoreDist(KVStoreLocal):
         return [self._call(s, ("profiler", sub, arg))
                 for s in range(len(self._servers))]
 
+    # -- pod telemetry channel (telemetry.aggregate rides this) ---------------
+    # Same transport discipline as server_profiler_command: a command on
+    # the existing worker->server wire. Snapshots all land on server 0
+    # (they are KB-scale; key-sharding them would buy nothing), stamped
+    # with the SERVER's receive time so rank-0 staleness ages never
+    # depend on worker clock agreement.
+
+    def telemetry_push(self, blob):
+        """Publish this rank's serialized telemetry snapshot
+        (pipelined ack — rides the push fast path, no round-trip)."""
+        self._post(0, ("telemetry_push", self._rank, blob))
+
+    def telemetry_pull(self):
+        """Fetch every rank's last snapshot: ``{rank: (age_seconds,
+        blob)}`` with ages measured on the server's clock."""
+        return self._call(0, ("telemetry_pull",))
+
     def set_gradient_compression(self, compression_params):
         from .gradient_compression import GradientCompression
 

@@ -297,6 +297,7 @@ class KVStoreServer:
         self.host = host or os.environ.get("DMLC_NODE_HOST", "127.0.0.1")
         self._keys = {}
         self._conn_rank = {}        # conn id -> worker rank (from hello)
+        self._telemetry = {}        # worker rank -> (recv_time, blob)
         self._updater = None
         self._opt_blob = None       # pickled optimizer for snapshots
         self._sync_mode = True
@@ -516,6 +517,20 @@ class KVStoreServer:
             if self._updater is not None:
                 self._updater.set_states(msg[1])
             self._send(conn, ("ok",))
+        elif cmd == "telemetry_push":
+            # Pod telemetry rendezvous (telemetry.aggregate): each rank
+            # publishes its serialized registry snapshot here (server 0
+            # by convention — snapshots are small); receive time is
+            # stamped on THIS server's monotonic clock, so staleness
+            # ages depend neither on worker clock agreement nor on NTP
+            # steps of the server's wall clock.
+            self._telemetry[msg[1]] = (time.monotonic(), msg[2])
+            self._send(conn, ("ok",))
+        elif cmd == "telemetry_pull":
+            now = time.monotonic()
+            self._send(conn, ("val", {rank: (now - t, blob)
+                                      for rank, (t, blob)
+                                      in self._telemetry.items()}))
         elif cmd == "profiler":
             # Remote server profiling (reference
             # KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49,

@@ -200,18 +200,21 @@ def server_dumps():
 
 
 def _op_table(reset=False):
-    """{op: (calls, total_s, min_s, max_s)} from the dispatch family.
-    With ``reset`` the family is drained (swap under the family lock)
-    before reading; at most one in-flight span per recorder thread can
-    fall between the snapshot and the fresh generation — the price of
-    not serializing every dispatch-path observe behind a global lock."""
+    """{op: (calls, total_s, min_s, max_s, p50_s, p99_s)} from the
+    dispatch family (quantiles interpolated from the histogram buckets,
+    clamped to the exact extrema). With ``reset`` the family is drained
+    (swap under the family lock) before reading; at most one in-flight
+    span per recorder thread can fall between the snapshot and the
+    fresh generation — the price of not serializing every
+    dispatch-path observe behind a global lock."""
     items = _dispatch.drain() if reset else _dispatch.collect()
     out = {}
     for (name,), child in items:
         snap = child.snapshot()
         if snap["count"]:
             out[name] = (snap["count"], snap["sum"], snap["min"],
-                         snap["max"])
+                         snap["max"], child.quantile(0.5),
+                         child.quantile(0.99))
     return out
 
 
@@ -226,17 +229,29 @@ def dumps(reset=False, format="table"):
     aggregate_stats.cc). ``format='table'`` renders the human-readable
     table (reference behavior); ``format='json'`` returns the same data
     machine-readable — {"trace_dir", "ops": {name: {calls, total_ms,
-    min_ms, max_ms}}, "counters": {"domain::name": value}} — for the
-    bench harness and serving dashboards.
+    min_ms, max_ms, p50_ms, p99_ms}}, "counters": {"domain::name":
+    value}} — for the bench harness and serving dashboards (the
+    histogram-derived p50/p99 the table shows ride the JSON payload
+    too, pinned by tests/test_profiler.py). ``format='top'`` renders
+    the pprof-style top-K self-time view
+    (:func:`mxnet_tpu.telemetry.flamegraph.render_top`) — the
+    flamegraph entry of the dispatch table.
 
     ``reset=True`` clears the per-op dispatch statistics. User-defined
     Counters are NOT reset: they are live gauges shared process-wide
     (checkpoint::pending, serving::requests) and zeroing them here would
     corrupt other subsystems' telemetry (behavior pinned by
     tests/test_profiler.py::test_dumps_reset_keeps_counters)."""
-    if format not in ("table", "json"):
-        raise ValueError("format must be 'table' or 'json', got %r"
-                         % (format,))
+    if format not in ("table", "json", "top"):
+        raise ValueError("format must be 'table', 'json' or 'top', "
+                         "got %r" % (format,))
+    if format == "top":
+        from .telemetry import flamegraph as _fg
+
+        text = _fg.render_top()
+        if reset:
+            _dispatch.drain()
+        return text
     ops = _op_table(reset=reset)
     counters = _counter_table()
     if format == "json":
@@ -245,20 +260,23 @@ def dumps(reset=False, format="table"):
         return json.dumps({
             "trace_dir": _trace_dir(),
             "ops": {name: {"calls": st[0], "total_ms": st[1] * 1e3,
-                           "min_ms": st[2] * 1e3, "max_ms": st[3] * 1e3}
+                           "min_ms": st[2] * 1e3, "max_ms": st[3] * 1e3,
+                           "p50_ms": st[4] * 1e3, "p99_ms": st[5] * 1e3}
                     for name, st in ops.items()},
             "counters": counters,
         })
     lines = [
         "Profile Statistics (framework dispatch spans; device timing "
         "is in the trace directory %r)" % _trace_dir(),
-        "%-40s %10s %14s %14s %14s" % ("Name", "Calls", "Total(ms)",
-                                       "Min(ms)", "Max(ms)"),
+        "%-40s %10s %14s %14s %14s %14s %14s"
+        % ("Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+           "P50(ms)", "P99(ms)"),
     ]
     for name in sorted(ops):
-        cnt, tot, mn, mx = ops[name]
-        lines.append("%-40s %10d %14.3f %14.3f %14.3f"
-                     % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3))
+        cnt, tot, mn, mx, p50, p99 = ops[name]
+        lines.append("%-40s %10d %14.3f %14.3f %14.3f %14.3f %14.3f"
+                     % (name, cnt, tot * 1e3, mn * 1e3, mx * 1e3,
+                        p50 * 1e3, p99 * 1e3))
     for name in sorted(counters):
         lines.append("%-40s %10s %14s" % (name, "counter",
                                           counters[name]))

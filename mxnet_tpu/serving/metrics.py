@@ -148,6 +148,25 @@ class ServingMetrics:
             }
         return out
 
+    def latency_slo(self, objective, threshold_s, name=None):
+        """Declare a latency SLO scoped to THIS server's request-latency
+        series (all buckets): "``objective`` of requests complete under
+        ``threshold_s``". Returns a
+        :class:`~mxnet_tpu.telemetry.slo.ServiceLevelObjective` ready
+        for ``BurnRateMonitor.add`` — the serving side of the SLO
+        burn-rate story::
+
+            burn = telemetry.BurnRateMonitor()
+            burn.add(srv.metrics.latency_slo(0.99, 0.250))
+            ...
+            burn.tick()     # in any loop, or a background timer
+        """
+        from ..telemetry.slo import ServiceLevelObjective
+
+        return ServiceLevelObjective(
+            name or "serving_latency_%s" % self._sid, objective,
+            threshold_s, self._latency, labels={"server": self._sid})
+
     @property
     def total_batches(self):
         return sum(c.value for c in self._mine(self._batches).values())

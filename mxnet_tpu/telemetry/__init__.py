@@ -38,20 +38,46 @@ Quick start::
 ``telemetry.set_enabled(False)`` pauses both metric recording and span
 capture (the bench.py ``telemetry_step_overhead_pct`` contract measures
 the difference: <= 2% on the step path).
+
+Pod scale (ISSUE 5) adds four more modules on the same registry/rings:
+
+* :mod:`.aggregate` — per-rank registry snapshots pushed over the
+  kvstore command channel and merged by rank 0 into one fleet registry
+  (every series labeled by ``rank``, silent ranks marked stale), so ONE
+  scrape shows the whole pod.
+* :mod:`.export` — streaming span export: the rings are drained on a
+  size/age rotation budget into immutable, atomically committed
+  ``trace.rank<R>.<SEQ>.jsonl`` segments; ``tools/trace_merge.py``
+  stitches per-rank segments into one Perfetto timeline.
+* :mod:`.slo` — multi-window error-budget burn rates over the latency
+  histogram families, ``mx_slo_burn_rate{slo,window}`` gauges and
+  rate-limited alerts.
+* :mod:`.flamegraph` — pprof-style top-K self-time table
+  (``profiler.dumps(format="top")``) and collapsed-stack output for
+  standard flamegraph tooling.
 """
 from __future__ import annotations
 
 from . import metrics
 from . import trace
+from . import aggregate
+from . import export
+from . import flamegraph
+from . import slo
 from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
                       render_prometheus, start_http_server,
                       default_buckets)
 from .health import StepMonitor
+from .aggregate import Aggregator, LocalBus
+from .export import StreamingTraceWriter
+from .slo import BurnRateMonitor, ServiceLevelObjective
 
-__all__ = ["metrics", "trace", "Registry", "REGISTRY", "counter",
-           "gauge", "histogram", "render_prometheus",
-           "start_http_server", "default_buckets", "StepMonitor",
-           "set_enabled", "enabled"]
+__all__ = ["metrics", "trace", "aggregate", "export", "flamegraph",
+           "slo", "Registry", "REGISTRY", "counter", "gauge",
+           "histogram", "render_prometheus", "start_http_server",
+           "default_buckets", "StepMonitor", "Aggregator", "LocalBus",
+           "StreamingTraceWriter", "BurnRateMonitor",
+           "ServiceLevelObjective", "set_enabled", "enabled"]
 
 
 def set_enabled(on):

@@ -1,0 +1,253 @@
+"""mxnet_tpu.telemetry.export — streaming span export with atomic
+segment commit.
+
+PR 3's tracing was dump-at-end: a multi-hour job's spans only hit disk
+if the process exits cleanly and calls ``trace.dump()`` — a preempted
+rank loses its whole timeline. This module replaces that with an
+incremental writer in the Dapper lineage: the span rings are drained on
+a rotation budget (bytes / age) and each batch is committed as an
+**immutable newline-delimited trace segment** using the checkpoint
+writer's tmp+fsync+rename protocol (the same ``_open_for_write`` /
+``_rename`` seams as :mod:`mxnet_tpu.checkpoint.manager`, so the test
+suite's ``fault_fs`` fixture injects faults into BOTH subsystems). A
+SIGKILL at any byte leaves only fully committed, individually loadable
+segments — ``tools/trace_merge.py`` stitches the per-rank segment sets
+into one Perfetto timeline with one lane per rank.
+
+Segment format (``trace.rank<R>.<SEQ>.jsonl``): one JSON object per
+line. The first line is a header ::
+
+    {"meta": {"format": "mxnet_tpu.trace_segment/1", "pid": ..,
+              "rank": .., "seq": ..,
+              "wall_anchor_us": .., "perf_anchor_us": ..}}
+
+and every following line is a chrome trace event (``ph``/``name``/
+``ts``/``pid``/``tid`` + ``dur`` for complete events), including
+``thread_name`` metadata events for every thread appearing in the
+segment — each segment is self-contained. The wall/perf anchor pair
+lets the merger rebase each process's ``time.perf_counter`` timestamps
+onto the shared wall clock so rank lanes align on one timeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+from . import trace as _trace
+from .. import log as _log
+
+__all__ = ["StreamingTraceWriter", "commit_bytes", "default_rank",
+           "SEGMENT_FORMAT", "segment_name", "SEGMENT_RE"]
+
+SEGMENT_FORMAT = "mxnet_tpu.trace_segment/1"
+SEGMENT_RE = re.compile(r"^trace\.rank(\d+)\.(\d+)\.jsonl$")
+
+
+def default_rank():
+    """This process's rank in the pod: ``parallel.dist`` when
+    initialized, else the launcher's ``DMLC_WORKER_ID``, else 0."""
+    try:
+        from ..parallel import dist as _dist
+
+        if _dist.is_initialized():
+            return _dist.rank()
+    except Exception:
+        pass
+    try:
+        return int(os.environ.get("DMLC_WORKER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def segment_name(rank, seq):
+    return "trace.rank%d.%06d.jsonl" % (rank, seq)
+
+
+def commit_bytes(path, data):
+    """Write ``data`` to ``path`` via staging-file + fsync + one atomic
+    rename — the checkpoint manager's single-file commit, through its
+    fault-injectable IO seams. Raises OSError (staging file removed,
+    target untouched) on failure."""
+    from ..checkpoint import manager as _ckpt
+
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    f = _ckpt._open_for_write(tmp)
+    try:
+        try:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        finally:
+            f.close()
+        _ckpt._rename(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _ckpt._fsync_dir(os.path.dirname(os.path.abspath(path)))
+    return path
+
+
+class StreamingTraceWriter:
+    """Incrementally flush the span rings to committed trace segments.
+
+    Parameters
+    ----------
+    directory : segment directory (created if missing; shared across
+        ranks — the rank is encoded in every segment name).
+    rank : lane id for this process (default :func:`default_rank`).
+    max_segment_bytes : commit the pending batch once its serialized
+        size reaches this (rotation by size; default 2 MiB).
+    max_segment_age_s : commit once the oldest pending event has waited
+        this long (rotation by age; default 30 s — an observer is never
+        more than one budget behind a live job).
+    clock : injectable monotonic clock for tests.
+
+    ``tick()`` is the step-loop entry point: drains the rings (cheap; a
+    handful of popleft calls when idle) and commits only when a budget
+    trips — commit failures are warned rate-limited and retried on the
+    next tick, never raised into the training loop. ``flush()`` commits
+    unconditionally and does raise, for shutdown paths that must know.
+    Committed segments are immutable; a kill between commits loses at
+    most one budget's worth of spans.
+    """
+
+    def __init__(self, directory, rank=None, max_segment_bytes=2 << 20,
+                 max_segment_age_s=30.0, clock=time.monotonic):
+        self.directory = directory
+        self.rank = default_rank() if rank is None else int(rank)
+        self.max_segment_bytes = int(max_segment_bytes)
+        self.max_segment_age_s = float(max_segment_age_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._lines = []            # serialized, not-yet-committed lines
+        self._bytes = 0
+        self._oldest = None         # clock() when _lines went non-empty
+        self._named = set()         # tids already announced this segment
+        self._closed = False
+        self.committed = []         # segment paths this writer produced
+        os.makedirs(directory, exist_ok=True)
+        # Resume-safe sequencing: a restarted process must extend the
+        # segment set, not overwrite it.
+        self._seq = 1 + max(
+            (int(m.group(2)) for m in map(SEGMENT_RE.match,
+                                          os.listdir(directory))
+             if m and int(m.group(1)) == self.rank), default=0)
+        self._anchor = {"wall_anchor_us": time.time() * 1e6,
+                        "perf_anchor_us": time.perf_counter() * 1e6}
+
+    # -- ingest ---------------------------------------------------------------
+
+    def _append_locked(self, thread_name, tid, events):
+        pid = os.getpid()
+        if tid not in self._named:
+            self._named.add(tid)
+            self._lines.append(json.dumps(
+                {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                 "ts": 0, "args": {"name": thread_name}},
+                separators=(",", ":")))
+            self._bytes += len(self._lines[-1]) + 1
+        for ph, name, ts, dur, args in events:
+            event = {"ph": ph, "name": name, "pid": pid, "tid": tid,
+                     "ts": ts}
+            if ph == "X":
+                event["dur"] = dur
+            elif ph == "i":
+                event["s"] = "t"
+            if args:
+                event["args"] = dict(args)
+            # default=str: span(**args) is an open API — a numpy scalar
+            # or other non-JSON arg must degrade to its string form, not
+            # raise out of the step loop with the batch already drained.
+            line = json.dumps(event, separators=(",", ":"), default=str)
+            self._lines.append(line)
+            self._bytes += len(line) + 1
+
+    def _drain_locked(self):
+        drained = _trace.drain()
+        if drained and self._oldest is None:
+            self._oldest = self._clock()
+        for thread_name, tid, events in drained:
+            self._append_locked(thread_name, tid, events)
+
+    # -- commit ---------------------------------------------------------------
+
+    def _commit_locked(self):
+        """Serialize pending lines into one immutable segment. Pending
+        state is cleared only after the rename lands, so a failed commit
+        retries with nothing lost."""
+        if not self._lines:
+            return None
+        header = json.dumps(
+            {"meta": dict(self._anchor, format=SEGMENT_FORMAT,
+                          pid=os.getpid(), rank=self.rank,
+                          seq=self._seq)},
+            separators=(",", ":"))
+        data = "\n".join([header] + self._lines) + "\n"
+        path = os.path.join(self.directory,
+                            segment_name(self.rank, self._seq))
+        commit_bytes(path, data.encode("utf-8"))
+        self._seq += 1
+        self._lines = []
+        self._bytes = 0
+        self._oldest = None
+        self._named = set()
+        self.committed.append(path)
+        return path
+
+    @property
+    def pending_events(self):
+        with self._lock:
+            return len(self._lines)
+
+    def tick(self):
+        """Step-loop cadence call: drain rings, commit when a rotation
+        budget (size or age) trips. Never raises — a commit failure is
+        warned (rate-limited) and retried next tick."""
+        with self._lock:
+            if self._closed:
+                return None
+            self._drain_locked()
+            over_size = self._bytes >= self.max_segment_bytes
+            over_age = (self._oldest is not None and
+                        self._clock() - self._oldest
+                        >= self.max_segment_age_s)
+            if not (over_size or over_age):
+                return None
+            try:
+                return self._commit_locked()
+            except Exception as exc:   # telemetry never kills the loop
+                _log.warn_rate_limited(
+                    _log.get_logger("mxnet_tpu.telemetry"),
+                    "trace_export:%d" % id(self), 30.0,
+                    "trace segment commit failed (will retry): %s", exc)
+                return None
+
+    def flush(self):
+        """Drain and commit whatever is pending (regardless of budget).
+        Raises OSError on commit failure — pending events are retained
+        for a retry. Returns the committed path, or None if empty."""
+        with self._lock:
+            self._drain_locked()
+            return self._commit_locked()
+
+    def close(self):
+        """Final flush (best-effort) and stop accepting ticks."""
+        try:
+            self.flush()
+        except Exception:
+            pass
+        with self._lock:
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

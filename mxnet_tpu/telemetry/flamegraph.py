@@ -1,0 +1,147 @@
+"""mxnet_tpu.telemetry.flamegraph — pprof-style top-K and collapsed
+stacks from the dispatch histograms and trace rings.
+
+Two complementary views of "where does the time go":
+
+1. **Top-K self time** (:func:`top` / :func:`render_top`) — the
+   ``mx_dispatch_seconds{op}`` histogram family folded into a ranked
+   table (calls, total, share, mean, p50/p99 from the bucket vectors).
+   This is ``pprof -top`` for the dispatch path and is what
+   ``profiler.dumps(format="top")`` renders.
+2. **Collapsed stacks** (:func:`collapsed` / :func:`dump_collapsed`) —
+   the trace rings' nested spans rebuilt into
+   ``thread;outer;inner <self_time_us>`` lines, the folded-stack format
+   every standard flamegraph tool consumes (flamegraph.pl, speedscope,
+   inferno). Self time is each span's duration minus its children's, so
+   the flame widths are honest — a parent that only dispatches shows
+   thin, the op that actually burns the time shows wide.
+
+Stack reconstruction uses the chrome events' ``ts``/``dur`` nesting per
+thread track: events are sorted by start (ties: longer first, i.e.
+parents before children) and a frame stack is maintained by popping
+every frame that ended before the next event starts. Spans recorded
+from ring overflow (oldest events silently dropped) can orphan a child
+— it then roots its own stack, which is the right degradation for a
+sampled view.
+"""
+from __future__ import annotations
+
+from . import metrics as _metrics
+from . import trace as _trace
+
+__all__ = ["top", "render_top", "collapsed", "dump_collapsed"]
+
+# Clock-granularity slack when deciding whether one span nests inside
+# another (µs; perf_counter is ns-resolution but float µs rounding can
+# put a child's end a hair past its parent's).
+_NEST_SLACK_US = 0.5
+
+
+def top(k=20, registry=None):
+    """Rank ops by total self time. Returns up to ``k`` rows
+    ``{op, calls, total_s, share, mean_ms, p50_ms, p99_ms}`` sorted by
+    ``total_s`` descending; ``share`` is the fraction of the summed
+    dispatch time. Dispatch spans do not nest (one per op call), so
+    self time == total time here."""
+    reg = registry or _metrics.REGISTRY
+    fam = reg.get("mx_dispatch_seconds")
+    rows = []
+    if fam is not None:
+        for (op,), child in fam.collect():
+            snap = child.snapshot()
+            if not snap["count"]:
+                continue
+            rows.append({
+                "op": op, "calls": snap["count"],
+                "total_s": snap["sum"],
+                "mean_ms": snap["sum"] / snap["count"] * 1e3,
+                "p50_ms": child.quantile(0.5) * 1e3,
+                "p99_ms": child.quantile(0.99) * 1e3,
+            })
+    grand = sum(r["total_s"] for r in rows) or 1.0
+    rows.sort(key=lambda r: r["total_s"], reverse=True)
+    for row in rows:
+        row["share"] = row["total_s"] / grand
+    return rows[:int(k)]
+
+
+def render_top(k=20, registry=None):
+    """The ``pprof -top``-shaped table over :func:`top`."""
+    rows = top(k=k, registry=registry)
+    lines = [
+        "Top %d ops by dispatch self time" % int(k),
+        "%-40s %10s %12s %7s %10s %10s %10s"
+        % ("Op", "Calls", "Total(ms)", "Share", "Mean(ms)", "P50(ms)",
+           "P99(ms)"),
+    ]
+    for r in rows:
+        lines.append(
+            "%-40s %10d %12.3f %6.1f%% %10.3f %10.3f %10.3f"
+            % (r["op"], r["calls"], r["total_s"] * 1e3,
+               r["share"] * 100.0, r["mean_ms"], r["p50_ms"],
+               r["p99_ms"]))
+    if not rows:
+        lines.append("(no dispatch spans recorded)")
+    return "\n".join(lines)
+
+
+def _track_stacks(events, root, folded):
+    """Fold one thread track's complete events into ``folded``
+    ({stack_path: self_time_us})."""
+    spans = sorted(
+        ((e["ts"], e.get("dur", 0.0), e["name"]) for e in events
+         if e.get("ph") == "X"),
+        key=lambda s: (s[0], -s[1]))
+    stack = []              # [[path, start_us, end_us, child_time_us]]
+
+    def pop():
+        path, start, end, child_time = stack.pop()
+        self_us = max(0.0, (end - start) - child_time)
+        folded[path] = folded.get(path, 0.0) + self_us
+
+    for ts, dur, name in spans:
+        while stack and ts >= stack[-1][2] - _NEST_SLACK_US:
+            pop()
+        path = (stack[-1][0] + ";" + name) if stack else \
+            (root + ";" + name)
+        if stack:
+            stack[-1][3] += dur
+        stack.append([path, ts, ts + dur, 0.0])
+    while stack:
+        pop()
+
+
+def collapsed(trace_data=None):
+    """Fold trace events into collapsed-stack lines
+    (``thread;span;child <self_us>``, one per unique stack, self time
+    in integer microseconds). ``trace_data`` defaults to the live
+    rings' :func:`mxnet_tpu.telemetry.trace.chrome_trace` merge; pass a
+    loaded dump (or ``tools/trace_merge.py`` output) to fold a file."""
+    data = _trace.chrome_trace() if trace_data is None else trace_data
+    events = data if isinstance(data, list) \
+        else data.get("traceEvents", [])
+    tracks = {}
+    names = {}
+    for event in events:
+        key = (event.get("pid", 0), event.get("tid", 0))
+        if event.get("ph") == "M" and event.get("name") == "thread_name":
+            names[key] = (event.get("args") or {}).get("name") \
+                or "tid-%s" % (key[1],)
+            continue
+        tracks.setdefault(key, []).append(event)
+    folded = {}
+    for key, track in sorted(tracks.items()):
+        root = names.get(key, "tid-%s" % (key[1],))
+        _track_stacks(track, root, folded)
+    return "\n".join("%s %d" % (path, round(us))
+                     for path, us in sorted(folded.items())
+                     if round(us) > 0) + ("\n" if folded else "")
+
+
+def dump_collapsed(path, trace_data=None):
+    """Write :func:`collapsed` output to ``path`` atomically (the
+    export module's tmp+fsync+rename commit); returns the path."""
+    from . import export as _export
+
+    _export.commit_bytes(path, collapsed(trace_data).encode("utf-8"))
+    return path

@@ -206,6 +206,14 @@ class StepMonitor:
                 self._ewma * 1e3,
                 "anomalies": dict(self.anomaly_counts)}
 
+    def record_anomaly(self, kind, msg):
+        """Public anomaly entry for external detectors (aggregation
+        rank-staleness, SLO burn alerts): counts into
+        ``mx_anomalies_total{kind=...}`` + the legacy profiler mirror,
+        drops a trace instant, and warns rate-limited per kind —
+        exactly the path the built-in detectors take."""
+        self._anomaly(kind, msg)
+
     # -- internals ------------------------------------------------------------
 
     def _anomaly(self, kind, msg):

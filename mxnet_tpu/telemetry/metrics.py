@@ -34,7 +34,7 @@ import threading
 from bisect import bisect_left
 
 __all__ = ["Registry", "CounterFamily", "GaugeFamily", "HistogramFamily",
-           "REGISTRY", "counter", "gauge", "histogram",
+           "MetricsServer", "REGISTRY", "counter", "gauge", "histogram",
            "render_prometheus", "start_http_server", "set_enabled",
            "enabled", "default_buckets"]
 
@@ -482,11 +482,66 @@ def render_prometheus(registry=None):
     return (registry or REGISTRY).render_prometheus()
 
 
+class MetricsServer:
+    """Handle for a running ``/metrics`` endpoint.
+
+    * ``port`` — the BOUND port (meaningful with ``port=0``: ask the OS
+      for a free one, read it back here).
+    * ``url`` — ready-to-curl scrape address.
+    * ``close()`` — shut the server down, release the listening socket,
+      and **join the serving thread**, so repeated start/close cycles in
+      one process (test suites) neither leak threads nor leave the port
+      in use; closing twice is a no-op.
+
+    Back-compat with the previous raw-server return: ``server_address``
+    and ``shutdown()`` keep working (``shutdown`` is ``close``).
+    """
+
+    def __init__(self, server, thread):
+        self._server = server
+        self._thread = thread
+        self._closed = False
+        # Captured at start: server_address is cleared by server_close().
+        self._address = server.server_address[:2]
+
+    @property
+    def server_address(self):
+        return self._address
+
+    @property
+    def port(self):
+        return self._address[1]
+
+    @property
+    def url(self):
+        return "http://%s:%d/metrics" % self._address
+
+    def close(self, timeout=5.0):
+        if self._closed:
+            return
+        self._closed = True
+        self._server.shutdown()         # stop serve_forever
+        self._server.server_close()     # release the listening socket
+        self._thread.join(timeout)
+
+    shutdown = close
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
 def start_http_server(port=0, addr="127.0.0.1", registry=None):
     """Serve ``render_prometheus()`` on ``http://addr:port/metrics`` from
     a daemon thread (stdlib http.server; no dependencies). ``port=0``
-    picks a free port — read it back from ``server.server_address``.
-    Returns the server; stop with ``server.shutdown()``."""
+    picks a free port. Returns a :class:`MetricsServer` handle — read
+    the bound port from ``.port``/``.url``, stop with ``.close()``
+    (which also joins the serving thread). ``registry`` accepts anything
+    with a ``render_prometheus()`` method — a :class:`Registry` or a
+    :class:`~mxnet_tpu.telemetry.aggregate.Aggregator` fleet view."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry or REGISTRY
@@ -511,4 +566,4 @@ def start_http_server(port=0, addr="127.0.0.1", registry=None):
     thread = threading.Thread(target=server.serve_forever,
                               name="mx-telemetry-http", daemon=True)
     thread.start()
-    return server
+    return MetricsServer(server, thread)

@@ -23,9 +23,14 @@ Design:
   emits ``"i"`` markers; ``complete()`` emits retroactive spans from
   explicit perf-counter timestamps (how the serving worker backfills a
   request's queue-wait once it knows when dispatch started).
-* **Flush, don't stream.** ``chrome_trace()`` merges the rings into a
+* **Flush or stream.** ``chrome_trace()`` merges the rings into a
   ``{"traceEvents": [...]}`` dict; ``dump(path)`` writes it as JSON
-  loadable in Perfetto / chrome://tracing alongside the XPlane capture.
+  loadable in Perfetto / chrome://tracing alongside the XPlane capture
+  (atomically — tmp+fsync+rename, so a crash mid-dump leaves the
+  previous file, never a truncated unloadable one). For multi-hour jobs
+  ``drain()`` detaches the buffered events instead, feeding
+  :class:`mxnet_tpu.telemetry.export.StreamingTraceWriter`'s
+  incremental segment files.
 
 ``set_enabled(False)`` turns ``span()`` bodies into no-ops (one boolean
 check) — the tracing half of the telemetry overhead contract.
@@ -39,8 +44,8 @@ import time
 from collections import deque
 
 __all__ = ["span", "instant", "complete", "chrome_trace", "dump",
-           "clear", "set_enabled", "enabled", "set_capacity", "capacity",
-           "event_count"]
+           "drain", "clear", "set_enabled", "enabled", "set_capacity",
+           "capacity", "event_count"]
 
 _DEFAULT_CAPACITY = 16384
 # Rings of dead threads retained for the next flush (most recent first
@@ -197,9 +202,48 @@ def chrome_trace():
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def drain(prune_dead=True):
+    """Detach and return every buffered event, leaving the rings empty
+    (the streaming exporter's read path). Returns
+    ``[(thread_name, tid, [event tuples])]`` — each tuple is the raw
+    ring record ``(ph, name, ts_us, dur_us, args)``. Rings stay
+    registered for their live owner threads; drained dead-thread rings
+    are released (their events are in the return value, nothing is
+    lost). An event appended concurrently with the drain lands in the
+    NEXT drain — popleft against the owner's append is safe on a deque.
+    """
+    with _registry_lock:
+        rings = list(_rings)
+    out = []
+    for thread, ring in rings:
+        events = []
+        while True:
+            try:
+                events.append(ring.popleft())
+            except IndexError:
+                break
+        if events:
+            out.append((thread.name, thread.ident or 0, events))
+    if prune_dead:
+        with _registry_lock:
+            _rings[:] = [entry for entry in _rings
+                         if entry[0].is_alive() or len(entry[1])]
+    return out
+
+
 def dump(path="chrome_trace.json"):
-    """Write ``chrome_trace()`` to ``path``; returns the path."""
+    """Write ``chrome_trace()`` to ``path`` atomically; returns the path.
+
+    The write goes through the checkpoint writer's tmp+fsync+rename
+    commit (via :func:`mxnet_tpu.telemetry.export.commit_bytes`): a
+    crash at any byte leaves either the previous dump or a stray tmp
+    file, never a truncated JSON that Perfetto refuses to load.
+    """
     data = chrome_trace()
-    with open(path, "w") as f:
-        json.dump(data, f)
+    from . import export as _export
+
+    # default=str: span args are an open API — a numpy scalar degrades
+    # to its string form instead of failing the whole dump.
+    _export.commit_bytes(path,
+                         json.dumps(data, default=str).encode("utf-8"))
     return path

@@ -1,0 +1,100 @@
+"""Worker program for the 2-process pod-observability smoke test
+(tests/test_telemetry_dist.py, launched via tools/launch.py roles).
+
+Each rank: records its own metrics + trace spans, streams trace
+segments to a shared directory, and pushes registry snapshots through
+the dist kvstore's telemetry channel. Rank 0 merges the pod view and
+writes ``scrape.txt`` (one exposition containing every rank's series)
+and ``merged_trace.json`` (one Perfetto timeline with a lane per rank).
+
+Modes:
+
+* ``normal`` — both ranks run to completion; rank 0's outputs must show
+  both ranks fresh.
+* ``kill`` — rank 1 SIGKILLs itself mid-run (after at least one
+  committed trace segment, with more spans buffered that never commit);
+  rank 0 must mark rank 1 stale within one aggregation interval and
+  still merge rank 1's committed segments.
+"""
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import mxnet_tpu as mx                                 # noqa: E402
+from mxnet_tpu import telemetry                        # noqa: E402
+from mxnet_tpu.telemetry import aggregate, trace       # noqa: E402
+from mxnet_tpu.telemetry import metrics as tm          # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "normal"
+    kv = mx.kvstore.create("dist_sync")
+    rank = kv.rank
+
+    steps = tm.REGISTRY.counter("podtest_steps_total",
+                                "per-rank step count", labels=("stage",))
+    step_s = tm.REGISTRY.histogram("podtest_step_seconds",
+                                   "per-rank step seconds")
+    writer = telemetry.StreamingTraceWriter(
+        out_dir, rank=rank, max_segment_age_s=0.0)  # commit every tick
+    monitor = telemetry.StepMonitor(warn_interval_s=0.0)
+    aggregator = aggregate.Aggregator(
+        kv, interval_s=0.0, stale_after_s=30.0 if mode == "normal"
+        else 1.0, monitor=monitor)
+
+    for i in range(5):
+        with trace.span("podtest::step", step=i, rank=rank):
+            time.sleep(0.01)
+        steps.labels(stage="train").inc()
+        step_s.observe(0.01)
+        aggregator.tick()
+        writer.tick()
+
+    if mode == "kill" and rank == 1:
+        # Committed segments exist; buffer more spans that never commit
+        # (the "mid-run" part), then die without any cleanup at all.
+        with trace.span("podtest::never_committed"):
+            pass
+        os.kill(os.getpid(), 9)
+
+    aggregator.step()               # final push
+    writer.flush()
+
+    if rank != 0:
+        kv._barrier()
+        return 0
+
+    if mode == "kill":
+        # Wait (bounded) for rank 1's silence to cross the staleness
+        # bar — one aggregation interval after stale_after_s.
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            aggregator.step()
+            if 'mx_rank_stale{rank="1"} 1' in aggregator.render_prometheus():
+                break
+            time.sleep(0.25)
+    else:
+        kv._barrier()               # peers' final pushes have landed
+        aggregator.step()
+
+    text = aggregator.render_prometheus()
+    with open(os.path.join(out_dir, "scrape.txt"), "w") as f:
+        f.write(text)
+
+    import trace_merge
+
+    trace_merge.merge([out_dir],
+                      out=os.path.join(out_dir, "merged_trace.json"))
+    anomalies = monitor.anomaly_counts if mode == "kill" else {}
+    with open(os.path.join(out_dir, "rank0_done.txt"), "w") as f:
+        f.write("rank_stale=%d\n" % anomalies.get("rank_stale", 0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,19 +38,24 @@ def test_train_mnist_synthetic():
 
 def test_train_telemetry_example(tmp_path):
     """README Observability snippet: TelemetryCallback + StepMonitor in
-    a TrainStep loop, chrome-trace capture, prometheus exposition."""
+    a TrainStep loop, streaming trace segments merged to a chrome
+    trace, fleet-view (rank-labeled) prometheus exposition."""
     import json
 
     out = _run([sys.executable, "examples/train_telemetry.py",
                 "--num-batches", "12", "--batch-size", "32",
                 "--out-dir", str(tmp_path)])
     assert "telemetry demo ok" in out
-    assert "mx_train_steps_total 12" in out
+    assert 'mx_train_steps_total{rank="0"} 12' in out
+    assert "mx_slo_burn_rate" in out
     with open(os.path.join(str(tmp_path), "chrome_trace.json")) as f:
         events = json.load(f)["traceEvents"]
     names = {e["name"] for e in events}
     assert any(n.startswith("train_step::") for n in names), names
     assert any(n.startswith("checkpoint::") for n in names), names
+    # streamed segments were committed and survive in the out dir
+    segs = os.listdir(os.path.join(str(tmp_path), "trace_segments"))
+    assert any(s.startswith("trace.rank0.") for s in segs), segs
 
 
 def test_train_imagenet_benchmark_mode():

@@ -88,6 +88,27 @@ def test_profiler_dumps_json_format():
         profiler.dumps(format="xml")
 
 
+def test_dumps_json_includes_histogram_percentiles():
+    """ISSUE 5 satellite schema regression: the histogram-derived
+    p50/p99 the table shows must ride the JSON payload too."""
+    import json
+
+    profiler.dumps(reset=True)
+    for ms in (1, 1, 1, 1, 50):
+        profiler.record_op_span("pctl_op", ms / 1e3)
+    payload = json.loads(profiler.dumps(format="json"))
+    st = payload["ops"]["pctl_op"]
+    assert set(st) == {"calls", "total_ms", "min_ms", "max_ms",
+                       "p50_ms", "p99_ms"}
+    assert st["min_ms"] <= st["p50_ms"] <= st["p99_ms"] <= st["max_ms"]
+    assert st["p99_ms"] > st["p50_ms"]      # the outlier shows up
+    # the table renders the same columns
+    table = profiler.dumps()
+    header = table.splitlines()[1]
+    assert "P50(ms)" in header and "P99(ms)" in header
+    profiler.dumps(reset=True)
+
+
 def test_dumps_reset_keeps_counters():
     """Pinned behavior (ISSUE 3 satellite): dumps(reset=True) clears the
     per-op dispatch stats but NOT user-defined Counters — they are live

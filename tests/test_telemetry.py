@@ -317,6 +317,48 @@ def test_step_monitor_warning_rate_limited(caplog):
         assert "suppressed" in emitted[-1].getMessage()
 
 
+def test_warn_rate_limited_concurrent_exactly_once(caplog):
+    """ISSUE 5 satellite: N threads racing the same key inside one
+    window emit EXACTLY one warning; every suppressed call is still
+    counted and reported on the next window's line."""
+    import logging
+
+    from mxnet_tpu import log as mxlog
+
+    logger = logging.getLogger("rate_limit_hammer")
+    key = "hammer:%d" % id(object())
+    n_threads, n_calls = 8, 200
+    results = []
+    barrier = threading.Barrier(n_threads)
+
+    def hammer():
+        barrier.wait()
+        mine = []
+        for _ in range(n_calls):
+            mine.append(mxlog.warn_rate_limited(
+                logger, key, 60.0, "storm warning", now=10.0))
+        results.append(mine)
+
+    with caplog.at_level("WARNING", logger="rate_limit_hammer"):
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        emitted = [r for r in caplog.records
+                   if "storm warning" in r.getMessage()]
+        assert len(emitted) == 1                 # exactly once
+        flat = [r for rs in results for r in rs]
+        assert flat.count(True) == 1             # one caller won
+        # next window: the one emission reports every suppressed call
+        assert mxlog.warn_rate_limited(
+            logger, key, 60.0, "storm warning", now=80.0) is True
+        tail = [r for r in caplog.records
+                if "storm warning" in r.getMessage()][-1].getMessage()
+        assert "+%d suppressed" % (n_threads * n_calls - 1) in tail
+
+
 def test_step_monitor_recompile_detection():
     class FakeOp:
         on_trace = None

@@ -1,0 +1,577 @@
+"""Pod-scale observability (ISSUE 5): cross-process metric aggregation,
+streaming span export with atomic segment commit, SLO burn-rate alerts,
+and the op flamegraph views."""
+import json
+import os
+import socket
+import sys
+import threading
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import aggregate, export, flamegraph, slo, trace
+from mxnet_tpu.telemetry import metrics as tmetrics
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import trace_merge  # noqa: E402
+from launch import launch_local  # noqa: E402
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- aggregation --------------------------------------------------------------
+
+def _mini_registry():
+    reg = tmetrics.Registry()
+    reg.counter("agg_steps_total", "steps", labels=("stage",)) \
+        .labels(stage="train").inc(3)
+    reg.gauge("agg_pending").set(2)
+    reg.histogram("agg_lat_seconds", buckets=(0.01, 0.1, 1.0)) \
+        .observe(0.05)
+    return reg
+
+
+def test_snapshot_merge_labels_every_series_by_rank():
+    reg = _mini_registry()
+    snap = aggregate.snapshot_registry(reg)
+    # snapshots must survive a pickle hop (the kvstore wire)
+    import pickle
+
+    snap = pickle.loads(pickle.dumps(snap))
+    fleet = aggregate.merge_snapshots({0: snap, 3: snap})
+    text = fleet.render_prometheus()
+    assert 'agg_steps_total{stage="train",rank="0"} 3' in text
+    assert 'agg_steps_total{stage="train",rank="3"} 3' in text
+    assert 'agg_pending{rank="3"} 2' in text
+    # full histogram bucket vectors survive the merge, per rank
+    assert 'agg_lat_seconds_bucket{rank="0",le="0.1"} 1' in text
+    assert 'agg_lat_seconds_count{rank="3"} 1' in text
+    fam = fleet.get("agg_lat_seconds")
+    assert fam.labels(rank="0").quantile(0.5) == pytest.approx(
+        0.05, rel=0.7)   # interpolated within the owning bucket
+
+
+def test_merge_rank_label_collision_uses_src_rank():
+    reg = tmetrics.Registry()
+    reg.gauge("already_ranked", labels=("rank",)).labels(rank="9").set(1)
+    fleet = aggregate.merge_snapshots(
+        {2: aggregate.snapshot_registry(reg)})
+    assert 'already_ranked{rank="9",src_rank="2"} 1' \
+        in fleet.render_prometheus()
+
+
+def test_aggregator_fleet_scrape_and_staleness():
+    """Two logical ranks over a LocalBus: one rank-0 scrape shows both;
+    a silent rank is marked stale within one aggregation interval and
+    feeds the StepMonitor's anomaly stream."""
+    clock = _FakeClock()
+    reg = _mini_registry()
+    bus = aggregate.LocalBus(num_workers=2, clock=clock)
+    monitor = telemetry.StepMonitor(clock=clock, warn_interval_s=1e9)
+    a0 = aggregate.Aggregator(bus.endpoint(0), registry=reg,
+                              interval_s=5.0, monitor=monitor,
+                              clock=clock)
+    a1 = aggregate.Aggregator(bus.endpoint(1), registry=reg,
+                              interval_s=5.0, clock=clock)
+    a1.step()
+    a0.step()
+    text = a0.render_prometheus()
+    assert 'agg_steps_total{stage="train",rank="0"} 3' in text
+    assert 'agg_steps_total{stage="train",rank="1"} 3' in text
+    assert 'mx_rank_stale{rank="1"} 0' in text
+    assert a1.fleet is None          # only rank 0 merges
+
+    # rank 1 goes silent: one aggregation interval past stale_after_s
+    # (default 3x interval) it is marked, its series stay visible, and
+    # the monitor hears about it
+    before = monitor.anomaly_counts.get("rank_stale", 0)
+    clock.t += 16.0
+    a0.step()
+    text = a0.render_prometheus()
+    assert 'mx_rank_stale{rank="1"} 1' in text
+    assert 'mx_rank_stale{rank="0"} 0' in text
+    assert 'agg_steps_total{stage="train",rank="1"} 3' in text
+    age = [l for l in text.splitlines()
+           if l.startswith('mx_rank_last_report_age_seconds{rank="1"}')]
+    assert age and float(age[0].split()[-1]) >= 16.0
+    assert monitor.anomaly_counts["rank_stale"] == before + 1
+
+
+def test_aggregator_tick_cadence_and_fallback_render():
+    clock = _FakeClock()
+    reg = _mini_registry()
+    bus = aggregate.LocalBus(num_workers=1, clock=clock)
+    agg = aggregate.Aggregator(bus.endpoint(0), registry=reg,
+                               interval_s=5.0, clock=clock)
+    # before any merge, a scrape falls back to the local registry
+    assert "agg_steps_total" in agg.render_prometheus()
+    assert agg.fleet is None
+    assert agg.tick() is not None    # first tick runs
+    assert agg.tick() is None        # within the interval: no-op
+    clock.t += 5.1
+    assert agg.tick() is not None
+
+
+def test_aggregator_never_reported_rank_counts_as_stale():
+    clock = _FakeClock()
+    bus = aggregate.LocalBus(num_workers=2, clock=clock)
+    agg = aggregate.Aggregator(bus.endpoint(0),
+                               registry=_mini_registry(),
+                               interval_s=1.0, clock=clock)
+    clock.t += 10.0                  # rank 1 never pushed at all
+    agg.step()
+    assert 'mx_rank_stale{rank="1"} 1' in agg.render_prometheus()
+
+
+# -- streaming span export ----------------------------------------------------
+
+def test_streaming_writer_rotates_and_segments_are_loadable(tmp_path):
+    clock = _FakeClock()
+    trace.clear()
+    w = export.StreamingTraceWriter(str(tmp_path), rank=0,
+                                    max_segment_bytes=1,  # every tick
+                                    max_segment_age_s=1e9, clock=clock)
+    for i in range(3):
+        with trace.span("stream::step", step=i):
+            pass
+        w.tick()
+    assert len(w.committed) == 3
+    names = []
+    for path in w.committed:
+        with open(path) as f:
+            lines = [json.loads(l) for l in f]
+        meta = lines[0]["meta"]
+        assert meta["format"] == export.SEGMENT_FORMAT
+        assert meta["rank"] == 0
+        assert "wall_anchor_us" in meta and "perf_anchor_us" in meta
+        names += [e["name"] for e in lines[1:] if e.get("ph") == "X"]
+    assert names.count("stream::step") == 3
+    # rings were drained, not copied: nothing duplicated at dump time
+    assert trace.event_count() == 0
+    w.close()
+
+
+def test_streaming_writer_age_budget_and_seq_resume(tmp_path):
+    clock = _FakeClock()
+    trace.clear()
+    w = export.StreamingTraceWriter(str(tmp_path), rank=1,
+                                    max_segment_age_s=10.0, clock=clock)
+    trace.instant("stream::early")
+    assert w.tick() is None          # age budget not hit yet
+    assert w.pending_events > 0
+    clock.t += 11.0
+    path = w.tick()
+    assert path and os.path.basename(path) == "trace.rank1.000001.jsonl"
+    w.close()
+    # a restarted writer EXTENDS the segment set (no overwrite)
+    w2 = export.StreamingTraceWriter(str(tmp_path), rank=1, clock=clock)
+    trace.instant("stream::later")
+    p2 = w2.flush()
+    assert os.path.basename(p2) == "trace.rank1.000002.jsonl"
+    w2.close()
+
+
+def test_streaming_commit_failure_keeps_events_and_retries(tmp_path,
+                                                           fault_fs):
+    """A failed segment commit (kill/EIO at the rename) leaves no
+    partial .jsonl, keeps the pending events, and the next flush
+    commits them."""
+    trace.clear()
+    w = export.StreamingTraceWriter(str(tmp_path), rank=0)
+    trace.instant("faulty::mark")
+    fault_fs.fail_next_renames(1)
+    with pytest.raises(OSError):
+        w.flush()
+    assert not [f for f in os.listdir(str(tmp_path))
+                if f.endswith(".jsonl")]
+    assert w.pending_events > 0
+    path = w.flush()                 # retry succeeds, nothing lost
+    with open(path) as f:
+        lines = [json.loads(l) for l in f]
+    assert any(e.get("name") == "faulty::mark" for e in lines)
+    w.close()
+
+
+def test_streaming_writer_survives_non_json_span_args(tmp_path):
+    """span(**args) is an open API: a numpy scalar arg must degrade to
+    its string form, not raise out of tick()/flush() with the batch
+    already drained from the rings."""
+    import numpy as np
+
+    trace.clear()
+    w = export.StreamingTraceWriter(str(tmp_path), rank=0)
+    trace.instant("np::mark", v=np.int64(3), a=np.ones(2))
+    path = w.flush()                 # must not raise
+    with open(path) as f:
+        lines = [json.loads(l) for l in f]
+    mark = [e for e in lines if e.get("name") == "np::mark"][0]
+    assert mark["args"]["v"] == "3"
+    w.close()
+    # trace.dump() shares the open-args contract
+    trace.instant("np::dumped", v=np.int64(7))
+    data = json.load(open(trace.dump(str(tmp_path / "d.json"))))
+    assert any(e["name"] == "np::dumped" for e in data["traceEvents"])
+
+
+def test_trace_dump_atomic_under_kill_mid_dump(tmp_path, fault_fs):
+    """ISSUE 5 satellite: a crash mid-``trace.dump()`` must leave the
+    previous dump intact — never a truncated, unloadable JSON."""
+    trace.clear()
+    path = str(tmp_path / "chrome_trace.json")
+    trace.instant("atomic::first")
+    assert trace.dump(path) == path
+    before = open(path).read()
+    json.loads(before)
+
+    trace.instant("atomic::second")
+    fault_fs.fail_next_writes(1)     # dies at the first staged byte
+    with pytest.raises(OSError):
+        trace.dump(path)
+    assert open(path).read() == before      # old dump untouched
+    assert not [f for f in os.listdir(str(tmp_path)) if ".tmp" in f]
+
+    fault_fs.fail_next_renames(1)    # dies at the commit rename
+    with pytest.raises(OSError):
+        trace.dump(path)
+    json.loads(open(path).read())    # still the old, loadable dump
+
+    out = trace.dump(path)           # clean retry wins
+    data = json.load(open(out))
+    assert any(e["name"] == "atomic::second"
+               for e in data["traceEvents"])
+
+
+# -- trace merge --------------------------------------------------------------
+
+def test_trace_merge_two_ranks_one_timeline(tmp_path):
+    trace.clear()
+    # two writers standing in for two ranks' processes
+    for rank in (0, 1):
+        w = export.StreamingTraceWriter(str(tmp_path), rank=rank)
+        with trace.span("merge::step", rank=rank):
+            pass
+        trace.instant("merge::mark", rank=rank)
+        w.flush()
+        w.close()
+    out = str(tmp_path / "merged.json")
+    merged = trace_merge.merge([str(tmp_path)], out=out)
+    data = json.load(open(out))      # loadable chrome trace JSON
+    events = data["traceEvents"]
+    pids = {e["pid"] for e in events if e.get("ph") != "M"}
+    assert pids == {0, 1}            # one lane per rank
+    pnames = {(e["pid"], e["args"]["name"]) for e in events
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert (0, "rank 0") in pnames and (1, "rank 1") in pnames
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    assert all(e["ts"] >= 0 for e in spans)   # rebased to a shared zero
+    assert merged["traceEvents"] == events
+
+
+def test_trace_merge_skips_torn_lines_and_takes_plain_dumps(tmp_path):
+    trace.clear()
+    trace.instant("dumped::mark")
+    dump = trace.dump(str(tmp_path / "chrome_trace.json"))
+    # an anchored streamed segment alongside the anchorless dump
+    w = export.StreamingTraceWriter(str(tmp_path / "seg"), rank=0)
+    trace.instant("streamed::mark")
+    w.flush()
+    w.close()
+    # a torn segment: valid header, one valid line, one truncated line
+    torn = tmp_path / "trace.rank7.000001.jsonl"
+    torn.write_text(
+        json.dumps({"meta": {"rank": 7}}) + "\n"
+        + json.dumps({"ph": "i", "name": "torn::ok", "ts": 1.0,
+                      "pid": 1, "tid": 1}) + "\n"
+        + '{"ph": "i", "name": "torn::lost", "ts"')
+    merged = trace_merge.merge([dump, str(torn),
+                                str(tmp_path / "seg")])
+    by_name = {e["name"]: e for e in merged["traceEvents"]}
+    assert "torn::ok" in by_name
+    assert "torn::lost" not in by_name
+    assert "dumped::mark" in by_name
+    # mixed time bases land on ONE usable timeline: anchorless inputs
+    # are aligned at their first event, so nothing sits wall-clock
+    # epochs away from the anchored (wall-rebased) lanes
+    spans = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    assert all(0 <= e["ts"] < 60e6 for e in spans), \
+        [(e["name"], e["ts"]) for e in spans]
+
+
+# -- SLO burn rate ------------------------------------------------------------
+
+def test_slo_threshold_snaps_up_and_label_filter():
+    reg = tmetrics.Registry()
+    fam = reg.histogram("slo_lat_seconds", labels=("server",),
+                        buckets=(0.1, 0.25, 0.5))
+    fam.labels(server="a").observe(0.2)      # good under 0.25
+    fam.labels(server="b").observe(0.4)      # bad under 0.25
+    s = slo.ServiceLevelObjective("lat", 0.99, 0.2, fam)
+    assert s.effective_threshold == 0.25     # snapped up
+    assert s.totals() == (1, 2)
+    scoped = slo.ServiceLevelObjective("lat_a", 0.99, 0.2, fam,
+                                       labels={"server": "a"})
+    assert scoped.totals() == (0, 1)
+    # lazy name resolution: family may not exist yet
+    lazy = slo.ServiceLevelObjective("lazy", 0.9, 0.1, "nope_seconds",
+                                     registry=reg)
+    assert lazy.totals() == (0, 0)
+    with pytest.raises(ValueError):
+        slo.ServiceLevelObjective("bad", 1.5, 0.1, fam)
+
+
+def test_slo_burn_rate_crosses_threshold_and_alerts_rate_limited(caplog):
+    """ISSUE 5 acceptance: fake-clock burn: the gauge crosses the alert
+    threshold on sustained errors, the alert fires rate-limited (one
+    line per window), and mx_anomalies_total counts every firing."""
+    clock = _FakeClock(1000.0)
+    reg = tmetrics.Registry()
+    fam = reg.histogram("burn_lat_seconds", buckets=(0.1, 0.25, 1.0))
+    import logging
+
+    logger = logging.getLogger("slo_burn_test")
+    burn = slo.BurnRateMonitor(windows=(300.0, 3600.0),
+                               alert_burn_rate=5.0, eval_interval_s=10.0,
+                               warn_interval_s=60.0, registry=reg,
+                               clock=clock, logger=logger)
+    burn.add_latency_slo("lat", 0.99, 0.25, fam)
+    gauge = reg.get("mx_slo_burn_rate")
+
+    # healthy traffic: burn stays 0, no alerts
+    for _ in range(10):
+        clock.t += 10.0
+        fam.observe(0.05)
+        burn.evaluate()
+    assert gauge.labels(slo="lat", window="5m").value == 0.0
+    assert reg.get("mx_slo_alerts_total").labels(slo="lat").value == 0
+
+    # sustained 100% errors: both windows burn at 1/0.01 = 100x
+    with caplog.at_level("WARNING", logger="slo_burn_test"):
+        for _ in range(12):
+            clock.t += 10.0
+            fam.observe(5.0)
+            burn.evaluate()
+        assert gauge.labels(slo="lat", window="5m").value \
+            > burn.alert_burn_rate
+        assert gauge.labels(slo="lat", window="1h").value \
+            > burn.alert_burn_rate
+        fired = reg.get("mx_slo_alerts_total").labels(slo="lat").value
+        assert fired >= 2
+        emitted = [r for r in caplog.records
+                   if "burning error budget" in r.getMessage()]
+        # rate-limited: many firings, few lines (one per 60s window)
+        assert 1 <= len(emitted) < fired
+        assert reg.get("mx_anomalies_total")
+        assert reg.get("mx_anomalies_total").labels(
+            kind="slo_burn").value == fired
+
+    # recovery: healthy traffic drains the short window back under
+    for _ in range(31):
+        clock.t += 10.0
+        fam.observe(0.05)
+        burn.evaluate()
+    assert gauge.labels(slo="lat", window="5m").value \
+        < burn.alert_burn_rate
+
+
+def test_slo_tick_cadence_and_monitor_routing():
+    clock = _FakeClock()
+    reg = tmetrics.Registry()
+    fam = reg.histogram("tick_lat_seconds", buckets=(0.1, 1.0))
+    monitor = telemetry.StepMonitor(clock=clock, warn_interval_s=1e9)
+    burn = slo.BurnRateMonitor(windows=(10.0,), alert_burn_rate=1.0,
+                               eval_interval_s=5.0, registry=reg,
+                               clock=clock, monitor=monitor)
+    burn.add_latency_slo("t", 0.5, 0.1, fam)
+    assert burn.tick() is not None
+    assert burn.tick() is None       # inside eval_interval_s
+    clock.t += 5.0
+    fam.observe(9.0)                 # 100% bad, budget 0.5 -> burn 2.0
+    clock.t += 5.0
+    out = burn.tick()
+    assert out["t"]["10s"] == pytest.approx(2.0)
+    # alert routed through the StepMonitor's anomaly path
+    assert monitor.anomaly_counts.get("slo_burn", 0) == 1
+
+
+def test_serving_latency_slo_helper_scopes_to_one_server():
+    from mxnet_tpu.serving.metrics import ServingMetrics
+
+    m1, m2 = ServingMetrics(), ServingMetrics()
+    try:
+        m1.record_request_latency(4, 0.5)    # slow on server 1
+        m2.record_request_latency(4, 0.01)   # fast on server 2
+        s = m1.latency_slo(0.99, 0.1)
+        bad, total = s.totals()
+        assert (bad, total) == (1, 1)        # m2's traffic not counted
+        assert s.name == "serving_latency_%s" % m1.server_id
+    finally:
+        m1.close()
+        m2.close()
+
+
+# -- flamegraph ---------------------------------------------------------------
+
+def test_flamegraph_top_ranks_by_self_time():
+    reg = tmetrics.Registry()
+    fam = reg.histogram("mx_dispatch_seconds", labels=("op",))
+    for _ in range(10):
+        fam.labels(op="heavy").observe(0.1)
+    fam.labels(op="light").observe(0.001)
+    rows = flamegraph.top(k=5, registry=reg)
+    assert [r["op"] for r in rows] == ["heavy", "light"]
+    assert rows[0]["calls"] == 10
+    assert rows[0]["share"] > 0.99
+    assert rows[0]["p99_ms"] >= rows[0]["p50_ms"] > 0
+    text = flamegraph.render_top(k=1, registry=reg)
+    assert "heavy" in text and "light" not in text
+
+
+def test_flamegraph_collapsed_self_time_nesting(tmp_path):
+    events = [
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 7, "ts": 0,
+         "args": {"name": "worker"}},
+        {"ph": "X", "name": "outer", "pid": 1, "tid": 7, "ts": 0.0,
+         "dur": 100.0},
+        {"ph": "X", "name": "inner", "pid": 1, "tid": 7, "ts": 10.0,
+         "dur": 30.0},
+        {"ph": "X", "name": "inner", "pid": 1, "tid": 7, "ts": 50.0,
+         "dur": 20.0},
+    ]
+    folded = flamegraph.collapsed({"traceEvents": events})
+    lines = dict(l.rsplit(" ", 1) for l in folded.strip().splitlines())
+    assert lines["worker;outer"] == "50"         # 100 - 30 - 20
+    assert lines["worker;outer;inner"] == "50"   # 30 + 20
+    # a bare traceEvents list (json.load(f)["traceEvents"]) works too
+    assert flamegraph.collapsed(events) == folded
+    path = flamegraph.dump_collapsed(str(tmp_path / "x.collapsed"),
+                                     {"traceEvents": events})
+    assert "worker;outer;inner 50" in open(path).read()
+
+
+def test_profiler_dumps_top_format():
+    mx.profiler.dumps(reset=True)
+    mx.profiler.record_op_span("fg_op", 0.02)
+    text = mx.profiler.dumps(format="top")
+    assert "fg_op" in text and "Share" in text
+    with pytest.raises(ValueError):
+        mx.profiler.dumps(format="flame")
+
+
+# -- http server handle (ISSUE 5 satellite) -----------------------------------
+
+def test_http_server_handle_scrape_close_restart_same_port():
+    reg = tmetrics.Registry()
+    reg.counter("handle_total").inc(5)
+    try:
+        srv = tmetrics.start_http_server(0, registry=reg)
+    except OSError as exc:
+        pytest.skip("cannot bind localhost: %s" % exc)
+    try:
+        import urllib.request
+
+        port = srv.port
+        assert port > 0                      # real bound port, not 0
+        assert srv.url.endswith(":%d/metrics" % port)
+        body = urllib.request.urlopen(srv.url, timeout=10).read()
+        assert b"handle_total 5" in body
+    finally:
+        srv.close()
+    # close() released the socket AND joined the thread: the same port
+    # binds again immediately, and no serving thread lingers
+    assert not any(t.name == "mx-telemetry-http"
+                   for t in threading.enumerate())
+    srv2 = tmetrics.start_http_server(port, registry=reg)
+    try:
+        assert srv2.port == port
+        import urllib.request
+
+        body = urllib.request.urlopen(srv2.url, timeout=10).read()
+        assert b"handle_total 5" in body
+    finally:
+        srv2.close()
+        srv2.close()                         # idempotent
+
+
+# -- 2-process acceptance -----------------------------------------------------
+
+_PROG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "telemetry_dist_prog.py")
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+}
+
+
+def _can_bind_localhost():
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+def _launch(tmp_path, mode):
+    if not _can_bind_localhost():
+        pytest.skip("localhost sockets unavailable (multi-process "
+                    "kvstore needs them)")
+    return launch_local(
+        2, 1, [sys.executable, _PROG, str(tmp_path), mode],
+        env_extra=_ENV, timeout=300)
+
+
+def test_two_process_pod_scrape_and_merged_trace(tmp_path):
+    """ISSUE 5 acceptance: a 2-process dist job yields ONE rank-0
+    scrape containing both ranks' series and ONE merged
+    Perfetto-loadable trace."""
+    codes = _launch(tmp_path, "normal")
+    assert codes == [0, 0], codes
+    text = (tmp_path / "scrape.txt").read_text()
+    for rank in (0, 1):
+        assert 'podtest_steps_total{stage="train",rank="%d"} 5' % rank \
+            in text, text
+        assert 'podtest_step_seconds_count{rank="%d"} 5' % rank in text
+        assert 'mx_rank_stale{rank="%d"} 0' % rank in text
+    with open(os.path.join(str(tmp_path), "merged_trace.json")) as f:
+        events = json.load(f)["traceEvents"]
+    span_pids = {e["pid"] for e in events
+                 if e.get("ph") == "X" and e["name"] == "podtest::step"}
+    assert span_pids == {0, 1}, span_pids    # one lane per rank
+    lanes = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert {"rank 0", "rank 1"} <= lanes
+
+
+def test_two_process_sigkill_leaves_segments_and_marks_stale(tmp_path):
+    """ISSUE 5 acceptance: SIGKILL of a rank mid-run leaves loadable
+    committed segments, and the survivor marks the dead rank stale
+    within one aggregation interval."""
+    codes = _launch(tmp_path, "kill")
+    # kv ranks come from scheduler registration order, so EITHER worker
+    # process may have drawn rank 1 (the SIGKILLed one): exactly one
+    # worker dies by signal, the rank-0 survivor exits clean.
+    assert sorted(codes) == [-9, 0], codes
+    text = (tmp_path / "scrape.txt").read_text()
+    assert 'mx_rank_stale{rank="1"} 1' in text, text
+    assert 'mx_rank_stale{rank="0"} 0' in text
+    # the dead rank's last reported series are still in the scrape
+    assert 'podtest_steps_total{stage="train",rank="1"}' in text
+    assert int((tmp_path / "rank0_done.txt").read_text()
+               .split("=")[1]) >= 1                 # anomaly fed
+    with open(os.path.join(str(tmp_path), "merged_trace.json")) as f:
+        events = json.load(f)["traceEvents"]
+    killed = [e for e in events if e.get("ph") == "X" and e["pid"] == 1]
+    assert killed, "rank 1's committed segments were lost"
+    assert not any(e["name"] == "podtest::never_committed"
+                   for e in events)

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Stitch per-rank streaming trace segments into ONE Perfetto timeline.
+
+`mxnet_tpu.telemetry.export.StreamingTraceWriter` leaves each rank a set
+of immutable `trace.rank<R>.<SEQ>.jsonl` segments (newline-delimited
+chrome events, atomic commits — a SIGKILLed rank still leaves every
+committed segment loadable). This tool merges any mix of segment files,
+segment directories, and whole `chrome_trace.json` dumps into a single
+`{"traceEvents": [...]}` file that Perfetto / chrome://tracing loads
+with **one process lane per rank**:
+
+* every event's `pid` is rewritten to its rank, with `process_name`
+  ("rank N") and `process_sort_index` metadata so lanes sort by rank;
+* segment headers carry a (wall clock, perf_counter) anchor pair, so
+  each process's monotonic timestamps are rebased onto the shared wall
+  clock — cross-rank spans line up on one timeline. Inputs WITHOUT an
+  anchor (plain `chrome_trace.json` dumps) have no shareable time base:
+  each such file is aligned at its own first event instead, so its lane
+  overlaps the timeline rather than landing decades away from the
+  wall-rebased lanes (true cross-source offsets are unknowable without
+  anchors);
+* truncated or foreign lines are skipped, never fatal (a merge of a
+  crashed job must succeed on whatever was committed).
+
+Usage::
+
+    python tools/trace_merge.py -o merged.json TRACE_DIR
+    python tools/trace_merge.py -o merged.json rank0_dump.json seg.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+SEG_RE = re.compile(r"trace\.rank(\d+)\.(\d+)\.jsonl$")
+
+
+def _expand(paths):
+    """Directories expand to their segment files (sorted: rank, seq);
+    explicit files pass through."""
+    out = []
+    for path in paths:
+        if os.path.isdir(path):
+            segs = []
+            for name in os.listdir(path):
+                m = SEG_RE.search(name)
+                if m:
+                    segs.append((int(m.group(1)), int(m.group(2)),
+                                 os.path.join(path, name)))
+            out.extend(p for _, _, p in sorted(segs))
+        else:
+            out.append(path)
+    return out
+
+
+def _iter_records(path):
+    """Yield parsed JSON objects from a .jsonl segment or a
+    chrome_trace.json dump; unparsable lines are skipped."""
+    with open(path) as f:
+        head = f.read(1)
+        if not head:
+            return
+        if head == "{" and not path.endswith(".jsonl"):
+            try:
+                data = json.loads(head + f.read())
+            except ValueError:
+                return
+            for event in data.get("traceEvents", []):
+                yield event
+            return
+        f.seek(0)
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue        # torn tail / foreign line
+
+
+def merge(paths, out=None):
+    """Merge segment/dump files into one trace-event dict (written
+    atomically to ``out`` when given). Returns the dict."""
+    events = []                 # (lane, time_domain, ts, event)
+    thread_names = {}           # (lane, tid) -> name
+    lanes = {}                  # lane -> display name
+    anon = 0
+    for file_idx, path in enumerate(_expand(paths)):
+        m = SEG_RE.search(os.path.basename(path))
+        rank = int(m.group(1)) if m else None
+        anchor = None
+        for rec in _iter_records(path):
+            meta = rec.get("meta") if isinstance(rec, dict) else None
+            if meta is not None:
+                if rank is None and "rank" in meta:
+                    rank = int(meta["rank"])
+                if "wall_anchor_us" in meta and "perf_anchor_us" in meta:
+                    anchor = (float(meta["wall_anchor_us"]),
+                              float(meta["perf_anchor_us"]))
+                continue
+            if not isinstance(rec, dict) or "ph" not in rec:
+                continue
+            if rank is None:
+                # A plain dump with no rank: its own lane, keyed by the
+                # original pid so multi-dump merges stay separated.
+                lane = "pid-%s" % rec.get("pid", anon)
+            else:
+                lane = rank
+            lanes.setdefault(lane, "rank %s" % lane if rank is not None
+                             else "process %s" % lane)
+            if rec.get("ph") == "M":
+                if rec.get("name") == "thread_name":
+                    key = (lane, rec.get("tid", 0))
+                    thread_names.setdefault(
+                        key, (rec.get("args") or {}).get("name"))
+                continue
+            ts = float(rec.get("ts", 0.0))
+            if anchor is not None:
+                ts = anchor[0] + (ts - anchor[1])
+            # Anchored sources share ONE wall-clock domain (their
+            # cross-rank offsets are real); each anchorless file is its
+            # own domain, aligned at its first event below.
+            domain = "wall" if anchor is not None else file_idx
+            events.append((lane, domain, ts, dict(rec)))
+        anon += 1
+
+    # Lane ids must be integers for the chrome format: ranks keep their
+    # number, anonymous lanes get numbers past the largest rank.
+    ranked = sorted(l for l in lanes if isinstance(l, int))
+    unranked = sorted(l for l in lanes if not isinstance(l, int))
+    base = (ranked[-1] + 1) if ranked else 0
+    pid_of = {l: l for l in ranked}
+    pid_of.update({l: base + i for i, l in enumerate(unranked)})
+
+    t0 = {}                     # time domain -> its first event
+    for _, domain, ts, _ in events:
+        t0[domain] = min(ts, t0.get(domain, ts))
+    out_events = []
+    for lane in ranked + unranked:
+        pid = pid_of[lane]
+        out_events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0, "ts": 0,
+                           "args": {"name": lanes[lane]}})
+        out_events.append({"ph": "M", "name": "process_sort_index",
+                           "pid": pid, "tid": 0, "ts": 0,
+                           "args": {"sort_index": pid}})
+    for (lane, tid), name in sorted(thread_names.items(),
+                                    key=lambda kv: str(kv[0])):
+        out_events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid_of[lane], "tid": tid, "ts": 0,
+                           "args": {"name": name}})
+    for lane, domain, ts, event in events:
+        event["pid"] = pid_of[lane]
+        event["ts"] = ts - t0[domain]
+        out_events.append(event)
+
+    merged = {"traceEvents": out_events, "displayTimeUnit": "ms"}
+    if out is not None:
+        tmp = "%s.tmp.%d" % (out, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(merged, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out)
+    return merged
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Merge per-rank trace segments into one "
+                    "Perfetto-loadable timeline.")
+    parser.add_argument("inputs", nargs="+",
+                        help="segment files, segment directories, or "
+                             "chrome_trace.json dumps")
+    parser.add_argument("-o", "--out", required=True,
+                        help="merged output path")
+    args = parser.parse_args(argv)
+    merged = merge(args.inputs, out=args.out)
+    n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+    lanes = len({e["pid"] for e in merged["traceEvents"]})
+    print("merged %d events across %d lanes -> %s" % (n, lanes, args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
