@@ -352,6 +352,112 @@ def _telemetry_dist_rows():
           "%")
 
 
+def _data_pipeline_rows():
+    """Data pipeline section (mxnet_tpu.data, ISSUE 6): per-batch decode
+    cost, prefetch overlap, and the step-path input-stall fraction
+    derived from the existing step/data_put trace spans.
+
+    THE CONTRACT ROW: data_prefetch_hidden_decode_pct >= 90 — when the
+    training step takes at least as long as a batch decodes, the decode
+    pool + double-buffered prefetcher must hide >= 90% of the decode
+    time (the consumer's wait per batch is <= 10% of the serial decode
+    cost)."""
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import data, gluon, recordio, telemetry
+    from mxnet_tpu.parallel import TrainStep, make_mesh
+    from mxnet_tpu.telemetry import trace
+
+    mx.random.seed(29)
+    rng = np.random.RandomState(29)
+    batch = 64        # big enough that fixed per-batch handoff cost is
+    shape = (3, 48, 48)  # noise against the ~75ms decode it must hide
+
+    with tempfile.TemporaryDirectory() as td:
+        rec = os.path.join(td, "ds.rec")
+        idx = os.path.join(td, "ds.idx")
+        w = recordio.MXIndexedRecordIO(idx, rec, "w")
+        for i in range(256):
+            img = (rng.rand(56, 56, 3) * 255).astype(np.uint8)
+            w.write_idx(i, recordio.pack_img(
+                recordio.IRHeader(0, float(i % 4), i, 0), img,
+                img_fmt=".jpg"))
+        w.close()
+
+        def make_pipe(prefetch):
+            return data.DataPipeline(
+                data.RecordDataset([rec]),
+                data.ImageRecordDecoder(shape, rand_crop=True,
+                                        rand_mirror=True),
+                batch_size=batch, shuffle=True, seed=29, num_shards=1,
+                shard_index=0, decode_threads=4, prefetch=prefetch,
+                place=False)
+
+        # Serial decode cost per batch (median): no prefetch thread, the
+        # consumer pays the full pool-fed decode latency inline.
+        with make_pipe(prefetch=0) as pipe:
+            n = pipe.batches_per_epoch
+            for _ in range(n):                  # warm page cache + pool
+                next(pipe)
+            costs = []
+            for _ in range(2 * n):
+                t0 = time.perf_counter()
+                next(pipe)
+                costs.append(time.perf_counter() - t0)
+            decode_ms = sorted(costs)[len(costs) // 2] * 1e3
+
+        # Prefetched: the consumer "trains" for >= the decode cost per
+        # batch; its residual blocking wait (median) is what prefetch
+        # failed to hide.
+        step_s = decode_ms / 1e3 * 1.5
+        with make_pipe(prefetch=2) as pipe:
+            next(pipe)                          # spin the stages up
+            time.sleep(step_s)
+            waits = []
+            for _ in range(2 * pipe.batches_per_epoch):
+                t0 = time.perf_counter()
+                next(pipe)
+                waits.append(time.perf_counter() - t0)
+                time.sleep(step_s)              # the simulated step
+            wait_ms = sorted(waits)[len(waits) // 2] * 1e3
+
+        hidden_pct = (1.0 - wait_ms / decode_ms) * 100.0
+        _emit("data_decode_ms_per_batch", round(decode_ms, 3), "ms")
+        _emit("data_prefetch_wait_ms_per_batch", round(wait_ms, 3), "ms")
+        # THE CONTRACT ROW (>= 90).
+        _emit("data_prefetch_hidden_decode_pct", round(hidden_pct, 2), "%")
+
+        # Input-stall fraction of a REAL step loop, from the spans the
+        # subsystems already emit (train_step::step / train_step::
+        # data_put / data::wait) — the pod-observability view of "is
+        # the input pipeline the ceiling?".
+        net = gluon.nn.HybridSequential(prefix="bench_data_")
+        net.add(gluon.nn.Flatten())
+        net.add(gluon.nn.Dense(64, activation="relu",
+                               in_units=int(np.prod(shape)),
+                               prefix="fc1_"))
+        net.add(gluon.nn.Dense(4, in_units=64, prefix="fc2_"))
+        net.initialize(mx.init.Xavier())
+        step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.05},
+                         mesh=make_mesh())
+        prev = telemetry.set_enabled(True)
+        try:
+            with make_pipe(prefetch=2) as pipe:
+                b = next(pipe)                  # compile outside the trace
+                float(np.asarray(step(b.data[0], b.label[0])))
+                trace.clear()
+                for _ in range(2 * pipe.batches_per_epoch):
+                    b = next(pipe)
+                    float(np.asarray(step(b.data[0], b.label[0])))
+                stall = data.stall_fraction()
+        finally:
+            telemetry.set_enabled(prev)
+        _emit("data_input_stall_fraction", round(stall, 4), "fraction")
+
+
 def _trainer_rows():
     """Trainer section (mxnet_tpu.fused_update): imperative update cost,
     per-param loop vs fused multi-tensor apply, at 10/100/1000
@@ -634,6 +740,11 @@ def main():
         _telemetry_dist_rows()
     except Exception:
         print("bench telemetry_dist section failed:", file=sys.stderr)
+        traceback.print_exc()
+    try:
+        _data_pipeline_rows()
+    except Exception:
+        print("bench data_pipeline section failed:", file=sys.stderr)
         traceback.print_exc()
     try:
         _trainer_rows()

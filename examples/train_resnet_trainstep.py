@@ -1,22 +1,27 @@
 #!/usr/bin/env python
-"""The blessed TPU training path, end to end: RecordIO → parallel
-decode → one fused SPMD executable per step → checkpoint.
+"""The blessed TPU training path, end to end: RecordIO → sharded
+streaming pipeline → one fused SPMD executable per step → checkpoint.
 
 This is the driver that shows how this framework actually trains fast
 on TPUs — unlike the reference-parity drivers (Module.fit / Trainer),
 every piece here is the TPU-first design:
 
 1. `im2rec`-style RecordIO dataset (synthetic images packed on the fly),
-2. `ImageRecordIter` with a `preprocess_threads` decode team behind a
-   background prefetcher,
+2. `mx.data.DataPipeline`: per-rank deterministic sharding, a
+   `--preprocess-threads` parallel decode pool, and double-buffered
+   async device prefetch — batch N+1 decodes and DMAs while the step
+   runs on batch N (the framework form of the old hand-rolled
+   preprocess_threads + PrefetchingIter assembly),
 3. `parallel.TrainStep`: forward + loss + backward + optimizer update
    compiled into ONE XLA executable over a `Mesh`, bf16 compute with
    fp32 master weights, buffer donation (in-place updates),
-4. bitwise `save_checkpoint`/`load_checkpoint`.
+4. bitwise `save_checkpoint`/`load_checkpoint`; the pipeline's own
+   `state_dict()` makes resume bit-exact *including data order*.
 
 On a pod: launch one process per host with `tools/launch.py -s 0 ...`
 and add `parallel.dist.initialize()` — the same script spans hosts
-(each worker feeds its `dist.local_slice` of the global batch).
+(each rank's pipeline produces its own equal-size shard of every
+epoch, so ranks never diverge in step count).
 
     python examples/train_resnet_trainstep.py --steps 30
 """
@@ -83,12 +88,26 @@ def main():
     with tempfile.TemporaryDirectory() as td:
         rec, idx = pack_dataset(os.path.join(td, "ds"), args.samples,
                                 args.image_size, args.classes, rng)
-        it = mx.io.ImageRecordIter(
-            path_imgrec=rec, path_imgidx=idx,
-            data_shape=(3, 48, 48), batch_size=args.batch_size,
-            shuffle=True, rand_crop=True, rand_mirror=True,
-            mean_r=30.0, mean_g=30.0, mean_b=30.0,
-            preprocess_threads=args.preprocess_threads)
+        # Per-rank pipeline: each process decodes only its equal-size
+        # shard of every epoch (num_shards/shard_index default from
+        # dist), so the global batch assembles with no local_slice math.
+        if args.batch_size % dist.num_processes():
+            raise SystemExit(
+                "--batch-size %d must divide evenly over %d processes"
+                % (args.batch_size, dist.num_processes()))
+        per_rank = args.batch_size // dist.num_processes()
+        it = mx.data.DataPipeline(
+            mx.data.RecordDataset([rec], [idx]),
+            mx.data.ImageRecordDecoder((3, 48, 48), rand_crop=True,
+                                       rand_mirror=True,
+                                       mean=np.array([30.0, 30.0, 30.0])),
+            batch_size=per_rank, shuffle=True, seed=args.seed,
+            decode_threads=args.preprocess_threads, prefetch=2,
+            # Multi-host: hand TrainStep host batches — it assembles the
+            # global array itself (make_array_from_process_local_data);
+            # a local device_put here would just add a wasted H2D plus a
+            # blocking D2H pull-back on the step path.
+            place=dist.num_processes() == 1)
 
         from mxnet_tpu.gluon.model_zoo import vision
 
@@ -104,15 +123,8 @@ def main():
         seen = 0
         t0 = None
         for s in range(args.steps):
-            try:
-                batch = next(it)
-            except StopIteration:
-                it.reset()
-                batch = next(it)
-            lo, hi = dist.local_slice(batch.data[0].shape[0])
-            x = batch.data[0].asnumpy()[lo:hi]
-            y = batch.label[0].asnumpy()[lo:hi]
-            loss = step(x, y)
+            batch = next(it)        # epochs advance inside the pipeline
+            loss = step(batch.data[0], batch.label[0])
             losses.append(float(np.asarray(jax.device_get(loss))))
             if s == 0:
                 t0 = time.monotonic()   # exclude compile from rate
@@ -122,8 +134,16 @@ def main():
                 logging.info("step %d  loss %.4f", s, losses[-1])
         rate = seen / (time.monotonic() - t0)
         ckpt = step.save_checkpoint(os.path.join(td, "final.params"))
+        # The pipeline cursor would ride a CheckpointManager save as
+        # {"step": step.state_dict(), "data": it.state_dict()} — resume
+        # then replays the exact remaining sample sequence.
+        data_state = it.state_dict()
+        it.close()
         logging.info("img/s (post-compile) %.1f   checkpoint %s  "
-                     "loss %.4f -> %.4f", rate, os.path.basename(ckpt),
+                     "input-stall %.0f%%  data epoch %d  loss %.4f -> %.4f",
+                     rate, os.path.basename(ckpt),
+                     100.0 * mx.data.stall_fraction(),
+                     data_state["epoch"],
                      np.mean(losses[:5]), np.mean(losses[-5:]))
         if not np.mean(losses[-5:]) < np.mean(losses[:5]):
             raise SystemExit("fused step did not reduce loss")

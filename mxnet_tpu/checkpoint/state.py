@@ -16,6 +16,10 @@ Covered frontends:
   and RNG position; first-class ``TrainStep.state_dict()`` including
   sharded per-process saves for SPMD meshes (Shard leaves; each host
   snapshots only its addressable shards).
+* ``data.DataPipeline`` / ``data.ShardedRecordStream`` — the input
+  pipeline's delivered-sample watermark (epoch, cursor, shard+shuffle
+  seed), closing the last nondeterminism gap: resume is bit-exact
+  *including data order*.
 
 ``state_dict(obj)`` dispatches on type; ``load_state_dict(obj, state)``
 reverses it. Adapters are also importable individually for composite
@@ -48,7 +52,23 @@ def state_dict(obj):
         return trainer_state(obj)
     if isinstance(obj, Block):
         return block_state(obj)
+    if _is_pipeline(obj):
+        return obj.state_dict()
     raise TypeError("no state adapter for %r" % type(obj).__name__)
+
+
+def _is_pipeline(obj):
+    # Lazy for real: an instance can only exist if its module is
+    # already loaded, so an absent module answers False without
+    # importing the data/telemetry stack just to raise TypeError.
+    import sys
+
+    pipeline = sys.modules.get("mxnet_tpu.data.pipeline")
+    reader = sys.modules.get("mxnet_tpu.data.reader")
+    kinds = tuple(k for k in (
+        pipeline and pipeline.DataPipeline,
+        reader and reader.ShardedRecordStream) if k)
+    return bool(kinds) and isinstance(obj, kinds)
 
 
 def load_state_dict(obj, state):
@@ -69,6 +89,9 @@ def load_state_dict(obj, state):
         return
     if isinstance(obj, Block):
         load_block_state(obj, state)
+        return
+    if _is_pipeline(obj):
+        obj.load_state_dict(state)
         return
     raise TypeError("no state adapter for %r" % type(obj).__name__)
 

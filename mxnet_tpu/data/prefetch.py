@@ -1,0 +1,145 @@
+"""Async device prefetch — double-buffered ``device_put`` overlap.
+
+The last stage of the input pipeline: a producer thread pulls assembled
+host batches, moves them toward the device (``jax.device_put`` — an
+async enqueue, so the H2D DMA itself overlaps the running step's
+compute), and parks them in a small bounded queue. ``depth=2`` is the
+classic double buffer: one batch on (or moving to) the device while the
+consumer trains on the previous one; deeper queues only add host memory
+and checkpoint-replay distance.
+
+Telemetry at the seam: ``mx_data_wait_seconds`` (how long the training
+loop blocked waiting for data — the input-stall truth the bench's
+stall-fraction row derives from) plus ``data::wait`` / ``data::put``
+trace spans alongside the existing ``train_step::data_put``.
+
+Producer exceptions are captured and re-raised in the consumer, and
+``close()`` is explicit and idempotent (context-manager protocol) — the
+two PrefetchingIter bugs this subsystem retires, fixed here by design.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+
+from ..telemetry import metrics as _tm
+from ..telemetry import trace as _trace
+
+__all__ = ["DevicePrefetcher", "data_wait_seconds"]
+
+data_wait_seconds = _tm.REGISTRY.histogram(
+    "mx_data_wait_seconds",
+    "Time the training loop blocked waiting for the next batch")
+_batches_total = _tm.REGISTRY.counter(
+    "mx_data_batches_total", "Batches delivered by the input pipeline")
+
+
+class _Stop:
+    """Sentinel: producer exhausted its source."""
+
+
+class _Raise:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class DevicePrefetcher:
+    """Background producer over ``source`` (an iterator of host
+    batches), applying ``place`` (default: identity) to each batch
+    before parking it in a ``depth``-bounded queue.
+
+    ``next(p)`` delivers placed batches in source order; a producer
+    error re-raises here; StopIteration propagates once the source is
+    drained. ``close()`` joins the thread (bounded) and is idempotent.
+    """
+
+    def __init__(self, source, depth=2, place=None):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._source = iter(source)
+        self._place = place
+        self._q = _queue.Queue(maxsize=int(depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._produce,
+                                        name="mx_data_prefetch",
+                                        daemon=True)
+        self._thread.start()
+
+    def _produce(self):
+        while not self._stop.is_set():
+            try:
+                batch = next(self._source)
+                if self._place is not None:
+                    with _trace.span("data::put"):
+                        batch = self._place(batch)
+            except StopIteration:
+                self._offer(_Stop())
+                return
+            except BaseException as exc:   # noqa: BLE001 — relayed to consumer
+                self._offer(_Raise(exc))
+                return
+            if not self._offer(batch):
+                return
+
+    def _offer(self, item):
+        """put() that stays responsive to close() instead of blocking
+        forever on a full queue nobody drains."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        item = self._q.get()
+        waited = time.perf_counter() - t0
+        _trace.complete("data::wait", t0, t0 + waited)
+        data_wait_seconds.observe(waited)
+        if isinstance(item, _Stop):
+            self._q.put(item)            # stay terminal on re-next()
+            raise StopIteration
+        if isinstance(item, _Raise):
+            self._q.put(item)            # stay broken, don't hang
+            raise item.exc
+        _batches_total.inc()
+        return item
+
+    next = __next__
+
+    def close(self, timeout=5.0):
+        """Stop the producer and join it (idempotent)."""
+        self._stop.set()
+        try:
+            while True:                   # unblock a full-queue producer
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=timeout)
+        try:                              # a batch the producer slipped
+            while True:                   # in during the join would sit
+                self._q.get_nowait()      # ahead of the sentinel
+        except _queue.Empty:
+            pass
+        try:                              # next() after close() raises
+            self._q.put_nowait(_Stop())   # StopIteration, never blocks
+        except _queue.Full:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close(timeout=1.0)
+        except Exception:
+            pass

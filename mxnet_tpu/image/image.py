@@ -520,9 +520,13 @@ class ImageIter(mxio.DataIter):
         self.path_root = path_root
         self.shuffle = shuffle
         self.seq = self.imgidx
+        # Equal-size wrap-tail sharding (data.sharding contract): every
+        # part gets ceil(N/num_parts) keys, the tail wraps to the head
+        # — no record is unreachable and ranks agree on batch count.
         if num_parts > 1 and self.seq is not None:
-            n = len(self.seq) // num_parts
-            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+            from ..data.sharding import shard_slice
+
+            self.seq = shard_slice(list(self.seq), num_parts, part_index)
         if aug_list is None:
             self.auglist = CreateAugmenter(data_shape, **kwargs)
         else:

@@ -22,6 +22,7 @@ import gzip
 import os
 import struct
 import threading
+import time as _time
 from collections import namedtuple
 
 import numpy as np
@@ -178,7 +179,19 @@ class ResizeIter(DataIter):
 class PrefetchingIter(DataIter):
     """Background-thread prefetcher over one or more iterators
     (reference io.py:PrefetchingIter; C++ analogue iter_prefetcher.h's
-    dmlc::ThreadedIter producer)."""
+    dmlc::ThreadedIter producer).
+
+    Worker-thread errors are captured and re-raised in the consumer's
+    ``next()`` — a decode exception must surface in the training loop,
+    not kill the producer and strand ``next()`` on an event forever.
+    The error consumes the whole ROUND across every sub-iterator; with
+    ``n_iter > 1`` the streams stay aligned afterwards only if the
+    failing sub-iterator consumed its underlying record before raising
+    (the decode-failure shape) — a sub-iterator that raises WITHOUT
+    advancing re-produces the same batch while its peers have moved on.
+    Shutdown is explicit: ``close()`` (idempotent, bounded join) or the
+    context-manager protocol; ``__del__`` remains a best-effort net.
+    """
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -197,6 +210,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        self.next_error = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -207,6 +221,14 @@ class PrefetchingIter(DataIter):
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
+                except BaseException as exc:  # relayed to the consumer
+                    self.next_batch[i] = None
+                    self.next_error[i] = exc
+                if not self.started:
+                    # close() landed while we produced: exit without
+                    # clear() — clearing here would clobber close()'s
+                    # set() and park this thread on wait() forever.
+                    break
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
 
@@ -216,12 +238,38 @@ class PrefetchingIter(DataIter):
         for thread in self.prefetch_threads:
             thread.start()
 
-    def __del__(self):
+    def close(self, timeout=1.0):
+        """Stop and join the producer threads (idempotent).
+
+        The stop event is RE-set in a loop: a worker that was mid-
+        produce when we flipped ``started`` clears ``data_taken`` on
+        its way back to ``wait()``, clobbering a one-shot ``set()`` and
+        blocking forever — so keep setting until the thread exits (or
+        the bounded timeout passes; workers are daemons)."""
+        if not self.started:
+            return
         self.started = False
-        for e in self.data_taken:
-            e.set()
-        for thread in self.prefetch_threads:
-            thread.join(timeout=1.0)
+        for e in self.data_taken:      # every worker gets the signal up
+            e.set()                    # front, whatever the join order
+        deadline = _time.monotonic() + timeout
+        for thread, e in zip(self.prefetch_threads, self.data_taken):
+            while thread.is_alive() and _time.monotonic() < deadline:
+                e.set()
+                thread.join(timeout=0.05)
+        for e in self.data_taken:      # re-signal any worker whose own
+            e.set()                    # clear() raced the loop above
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -242,18 +290,38 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
+        if not self.started:
+            raise RuntimeError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
         for i in self.iters:
             i.reset()
+        # A captured worker error dies with the epoch it happened in.
+        self.next_error = [None for _ in range(self.n_iter)]
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
             e.set()
 
     def iter_next(self):
+        if not self.started:
+            # No workers left to refill the slots: a stale parked batch
+            # followed by an unfillable wait() would hang the loop.
+            raise StopIteration
         for e in self.data_ready:
             e.wait()
+        pending = [exc for exc in self.next_error if exc is not None]
+        if pending:
+            # The whole ROUND is consumed by the error: clear every
+            # error slot and recycle every iterator — the sub-iterators
+            # advance in lockstep, so a stale parked batch (or a stale
+            # second error raised a batch late) would pair stream i's
+            # batch k+1 with peer batch k forever after.
+            self.next_error = [None for _ in range(self.n_iter)]
+            for j in range(self.n_iter):
+                self.data_ready[j].clear()
+                self.data_taken[j].set()
+            raise pending[0]
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "iterators (of different length) all end together"
@@ -481,11 +549,16 @@ class MNISTIter(DataIter):
         label = label if os.path.exists(label) else label + ".gz"
         images = _read_idx_ubyte(image).astype(np.float32) / 255.0
         labels = _read_idx_ubyte(label).astype(np.float32)
-        # Data-parallel sharding across workers (iter_mnist.cc num_parts).
+        # Data-parallel sharding across workers (iter_mnist.cc
+        # num_parts) — equal-size wrap-tail shards: every part gets
+        # exactly ceil(N/num_parts) samples (the tail wraps to the
+        # head instead of being silently dropped), so every record is
+        # reachable and all ranks run the same step count per epoch.
         if num_parts > 1:
-            n = images.shape[0] // num_parts
-            images = images[part_index * n:(part_index + 1) * n]
-            labels = labels[part_index * n:(part_index + 1) * n]
+            from .data.sharding import shard_slice
+
+            images = shard_slice(images, num_parts, part_index)
+            labels = shard_slice(labels, num_parts, part_index)
         if shuffle:
             rng = np.random.RandomState(seed)
             order = rng.permutation(images.shape[0])

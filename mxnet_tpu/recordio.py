@@ -27,7 +27,8 @@ from collections import namedtuple
 import numpy as np
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
-           "pack", "unpack", "pack_img", "unpack_img"]
+           "pack", "unpack", "pack_img", "unpack_img",
+           "read_logical_record", "native_reads_enabled"]
 
 _kMagic = 0xced7230a
 _LREC_FLAG_BITS = 29
@@ -40,6 +41,49 @@ def _encode_lrec(cflag, length):
 
 def _decode_lrec(lrec):
     return lrec >> _LREC_FLAG_BITS, lrec & _LREC_LENGTH_MASK
+
+
+def read_logical_record(f, uri="<stream>"):
+    """One logical record (continuation chunks reassembled) from the
+    current position of an open binary handle; None at clean EOF. The
+    single authoritative python frame walk — MXRecordIO.read and the
+    data subsystem's random-access reader both delegate here."""
+    parts = []
+    while True:
+        header = f.read(8)
+        if len(header) < 8:
+            return b"".join(parts) if parts else None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _kMagic:
+            raise IOError("Invalid RecordIO magic number in %s" % uri)
+        cflag, length = _decode_lrec(lrec)
+        data = f.read(length)
+        if len(data) < length:
+            raise IOError("Truncated record in %s" % uri)
+        pad = (4 - length % 4) % 4
+        if pad:
+            f.read(pad)
+        parts.append(data)
+        if cflag in (0, 3):  # whole record or final continuation
+            return b"".join(parts)
+
+
+_NATIVE_OK = None
+
+
+def native_reads_enabled():
+    """True when random-access reads should go through the C++ core.
+    The ``MXNET_USE_NATIVE_RECORDIO`` escape hatch is re-read on every
+    call (tests and fault harnesses flip it mid-process); only the
+    expensive availability probe is cached."""
+    global _NATIVE_OK
+    if os.environ.get("MXNET_USE_NATIVE_RECORDIO", "1") == "0":
+        return False
+    if _NATIVE_OK is None:
+        from . import recordio_native
+
+        _NATIVE_OK = recordio_native.available()
+    return _NATIVE_OK
 
 
 class MXRecordIO:
@@ -126,24 +170,7 @@ class MXRecordIO:
         """Read next record as bytes, or None at EOF."""
         assert not self.writable
         self._check_pid(allow_reset=True)
-        parts = []
-        while True:
-            header = self.record.read(8)
-            if len(header) < 8:
-                return b"".join(parts) if parts else None
-            magic, lrec = struct.unpack("<II", header)
-            if magic != _kMagic:
-                raise IOError("Invalid RecordIO magic number in %s" % self.uri)
-            cflag, length = _decode_lrec(lrec)
-            data = self.record.read(length)
-            if len(data) < length:
-                raise IOError("Truncated record in %s" % self.uri)
-            pad = (4 - length % 4) % 4
-            if pad:
-                self.record.read(pad)
-            parts.append(data)
-            if cflag in (0, 3):  # whole record or final continuation
-                return b"".join(parts)
+        return read_logical_record(self.record, self.uri)
 
     def tell(self):
         """Current file position (valid as an index key when writing)."""
@@ -220,18 +247,14 @@ class MXIndexedRecordIO(MXRecordIO):
         self.seek(idx)
         return self.read()
 
+    # Explicit test override: None = defer to the shared module gate.
     _native_ok = None
 
     def _native_reads(self):
         cls = type(self)
-        if cls._native_ok is None:
-            if os.environ.get("MXNET_USE_NATIVE_RECORDIO", "1") == "0":
-                cls._native_ok = False
-            else:
-                from . import recordio_native
-
-                cls._native_ok = recordio_native.available()
-        return cls._native_ok and not self.writable
+        if cls._native_ok is not None:
+            return cls._native_ok and not self.writable
+        return native_reads_enabled() and not self.writable
 
     def write_idx(self, idx, buf):
         """Append record and index it under key `idx`."""

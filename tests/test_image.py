@@ -65,6 +65,31 @@ def test_image_record_iter(tmp_path):
     assert b.label[0].shape == (4,)
 
 
+def test_image_iter_num_parts_wrap_tail(tmp_path):
+    """ImageIter num_parts sharding is equal-size wrap-tail: 3 parts of
+    10 records each see 4 keys, union covers all 10 (the reference's
+    truncating division left record 9 unreachable and sized rank step
+    counts unevenly)."""
+    rec_path = str(tmp_path / "p.rec")
+    idx_path = str(tmp_path / "p.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(10):
+        im = (np.random.rand(40, 40, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), im, img_fmt=".jpg"))
+    w.close()
+    seen = []
+    for part in range(3):
+        it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                             path_imgrec=rec_path, path_imgidx=idx_path,
+                             num_parts=3, part_index=part)
+        assert len(it.seq) == 4                # equal on every part
+        b = next(it)
+        seen.extend(np.asarray(b.label[0].asnumpy()).tolist())
+    assert set(seen) == set(float(i) for i in range(10))
+    assert len(seen) == 12
+
+
 def test_image_iter_list(tmp_path):
     import cv2
 

@@ -59,6 +59,138 @@ def test_prefetching_iter():
     assert len(list(it)) == 4
 
 
+class _BoomIter(mx.io.DataIter):
+    """Yields one good batch, then raises — the decode-failure shape."""
+
+    def __init__(self, inner, boom_at=1):
+        super().__init__(inner.batch_size)
+        self.inner = inner
+        self.boom_at = boom_at
+        self.count = 0
+
+    @property
+    def provide_data(self):
+        return self.inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self.inner.provide_label
+
+    def reset(self):
+        self.count = 0
+        self.inner.reset()
+
+    def next(self):
+        self.count += 1
+        if self.count - 1 == self.boom_at:   # raise once, then recover
+            self.inner.next()   # record consumed, then decode failed
+            raise ValueError("decode exploded")
+        return self.inner.next()
+
+
+def test_prefetching_iter_worker_error_reraises_not_hangs():
+    """Regression: prefetch_func caught only StopIteration, so any
+    decode exception killed the worker thread and next() blocked on
+    data_ready forever. The error must surface in the consumer."""
+    inner = mx.io.NDArrayIter(np.zeros((20, 3), np.float32), batch_size=5)
+    with mx.io.PrefetchingIter(_BoomIter(inner)) as it:
+        next(it)                               # the good batch
+        with pytest.raises(ValueError, match="decode exploded"):
+            next(it)                           # re-raised, not a hang
+        # the iterator recovers: worker keeps producing after the error
+        assert next(it) is not None
+
+
+def test_prefetching_iter_close_idempotent_and_context_manager():
+    inner = mx.io.NDArrayIter(np.zeros((20, 3), np.float32), batch_size=5)
+    with mx.io.PrefetchingIter(inner) as it:
+        next(it)
+    assert not it.started
+    for t in it.prefetch_threads:
+        assert not t.is_alive()
+    it.close()                                 # idempotent
+    it.close()
+    with pytest.raises(StopIteration):         # never a stale batch or
+        next(it)                               # an unfillable wait()
+    with pytest.raises(RuntimeError, match="closed"):
+        it.reset()
+
+
+class _SlowIter(mx.io.DataIter):
+    """Takes a while per batch — close() lands mid-produce."""
+
+    def __init__(self, inner, delay=0.15):
+        super().__init__(inner.batch_size)
+        self.inner = inner
+        self.delay = delay
+
+    @property
+    def provide_data(self):
+        return self.inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self.inner.provide_label
+
+    def reset(self):
+        self.inner.reset()
+
+    def next(self):
+        import time
+
+        time.sleep(self.delay)
+        return self.inner.next()
+
+
+def test_prefetching_iter_close_mid_produce_joins_worker():
+    """Regression: a worker mid-produce clears data_taken on its way
+    back to wait(), clobbering a one-shot close() set() — the thread
+    then leaked forever. close() must keep signalling until the worker
+    exits."""
+    inner = mx.io.NDArrayIter(np.zeros((20, 3), np.float32), batch_size=5)
+    it = mx.io.PrefetchingIter(_SlowIter(inner))
+    it.close(timeout=5.0)                      # immediately: mid-produce
+    for t in it.prefetch_threads:
+        assert not t.is_alive(), "worker leaked past close()"
+
+
+def test_prefetching_iter_error_keeps_multi_iter_streams_aligned():
+    """After one sub-iterator errors, EVERY sub-iterator's slot is
+    recycled — otherwise stream i's batch k+1 pairs with peer streams'
+    stale batch k forever."""
+    a = np.arange(20, dtype=np.float32).reshape(20, 1)
+    good = mx.io.NDArrayIter(a, batch_size=5, data_name="g")
+    flaky = _BoomIter(mx.io.NDArrayIter(a + 100, batch_size=5,
+                                        data_name="f"), boom_at=1)
+    with mx.io.PrefetchingIter([flaky, good]) as it:
+        b0 = next(it)
+        assert float(b0.data[0].asnumpy()[0, 0]) == 100.0   # flaky k=0
+        assert float(b0.data[1].asnumpy()[0, 0]) == 0.0     # good  k=0
+        with pytest.raises(ValueError, match="decode exploded"):
+            next(it)
+        b2 = next(it)       # round k=1 is consumed by the error on BOTH
+        assert float(b2.data[0].asnumpy()[0, 0]) == 110.0   # flaky k=2
+        assert float(b2.data[1].asnumpy()[0, 0]) == 10.0    # good  k=2
+
+
+def test_prefetching_iter_both_workers_error_one_raise_no_stale():
+    """When BOTH sub-iterators error in the same round, one exception
+    surfaces and the round is consumed — no stale second error raised a
+    batch late, no silently dropped good batch after it."""
+    a = np.arange(20, dtype=np.float32).reshape(20, 1)
+    f1 = _BoomIter(mx.io.NDArrayIter(a, batch_size=5, data_name="x"),
+                   boom_at=1)
+    f2 = _BoomIter(mx.io.NDArrayIter(a + 100, batch_size=5,
+                                     data_name="y"), boom_at=1)
+    with mx.io.PrefetchingIter([f1, f2]) as it:
+        next(it)                                   # round 0
+        with pytest.raises(ValueError, match="decode exploded"):
+            next(it)                               # round 1: ONE raise
+        b2 = next(it)                              # round 2, not stale
+        assert float(b2.data[0].asnumpy()[0, 0]) == 10.0
+        assert float(b2.data[1].asnumpy()[0, 0]) == 110.0
+
+
 def test_recordio_roundtrip(tmp_path):
     path = str(tmp_path / "test.rec")
     writer = recordio.MXRecordIO(path, "w")
@@ -169,24 +301,53 @@ def test_custom_metric():
     assert abs(m.get()[1] - 0.5) < 1e-6
 
 
-def test_mnist_iter_synthetic(tmp_path):
-    """MNISTIter over synthetic IDX files (iter_mnist.cc format)."""
+def _make_mnist(tmp_path, n=50):
+    """Synthetic IDX files (iter_mnist.cc format); labels are unique so
+    coverage is checkable through the label stream."""
     import struct
 
-    images = (np.random.rand(50, 28, 28) * 255).astype(np.uint8)
-    labels = np.random.randint(0, 10, 50).astype(np.uint8)
+    images = (np.random.rand(n, 28, 28) * 255).astype(np.uint8)
+    labels = np.arange(n, dtype=np.uint8)
     img_path = str(tmp_path / "images-idx3-ubyte")
     lbl_path = str(tmp_path / "labels-idx1-ubyte")
     with open(img_path, "wb") as f:
         f.write(struct.pack(">HBB", 0, 8, 3))
-        f.write(struct.pack(">III", 50, 28, 28))
+        f.write(struct.pack(">III", n, 28, 28))
         f.write(images.tobytes())
     with open(lbl_path, "wb") as f:
         f.write(struct.pack(">HBB", 0, 8, 1))
-        f.write(struct.pack(">I", 50))
+        f.write(struct.pack(">I", n))
         f.write(labels.tobytes())
+    return img_path, lbl_path, labels
+
+
+def test_mnist_iter_synthetic(tmp_path):
+    """MNISTIter over synthetic IDX files (iter_mnist.cc format)."""
+    img_path, lbl_path, _ = _make_mnist(tmp_path)
     it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
                          shuffle=False, flat=True)
     b = next(it)
     assert b.data[0].shape == (10, 784)
     assert b.label[0].shape == (10,)
+
+
+def test_mnist_iter_num_parts_equal_and_total(tmp_path):
+    """num_parts shards are equal-size wrap-tail (data.sharding): with
+    50 samples over 3 parts every part sees 17 (not 16 with 2 records
+    silently unreachable) and the union covers every sample."""
+    img_path, lbl_path, labels = _make_mnist(tmp_path)
+    seen = []
+    for part in range(3):
+        it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=17,
+                             shuffle=False, flat=True, num_parts=3,
+                             part_index=part)
+        got = []
+        for b in it:
+            got.extend(np.asarray(b.label[0].asnumpy()).tolist())
+        assert len(got) == 17                  # ceil(50/3), every part
+        # each part is the contiguous wrap-tail slice — deterministic
+        want = [float(labels[(part * 17 + j) % 50]) for j in range(17)]
+        assert got == want, "part %d is not the wrap-tail slice" % part
+        seen.extend(got)
+    assert set(seen) == set(float(l) for l in labels)   # total coverage
+    assert len(seen) == 51                              # one wrap dup
