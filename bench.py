@@ -352,6 +352,135 @@ def _telemetry_dist_rows():
           "%")
 
 
+def _diagnostics_rows():
+    """Diagnostics section (ISSUE 7): what failure forensics costs when
+    nothing is failing. THE CONTRACT ROWS:
+    numeric_guard_step_overhead_pct <= 2 (an every-step NumericGuard
+    loss check — the isfinite read piggybacks on the loss readback a
+    real loop already pays) and watchdog_idle_overhead_pct <= 1 (a
+    running HangWatchdog: TrainStep's begin/end heartbeats plus the
+    4 Hz scan thread amortized over the step).
+
+    Measurement discipline: an A/A interleaved-min experiment on this
+    shared-core box shows a ±9% noise floor on the ms-scale step —
+    loop-level A/B timing cannot resolve a 1-2% bound, it can only
+    flap. The contract rows therefore measure the HOOKS directly
+    (thousands of calls against a settled loss / armed lanes — they
+    are µs-scale, trivially resolvable) and express the exact per-step
+    addition as a percentage of the interleaved median step time; the
+    wall-clock A/B rows stay as informative context. A
+    flight-recorder capture is also timed (informative): the one-off
+    cost of producing a bundle at the moment of failure."""
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.parallel import TrainStep, make_mesh
+
+    mx.random.seed(23)
+    rng = np.random.RandomState(23)
+    net = gluon.nn.HybridSequential(prefix="bench_diag_")
+    net.add(gluon.nn.Dense(1024, activation="relu", in_units=784,
+                           prefix="fc1_"))
+    net.add(gluon.nn.Dense(1024, activation="relu", in_units=1024,
+                           prefix="fc2_"))
+    net.add(gluon.nn.Dense(10, in_units=1024, prefix="fc3_"))
+    net.initialize(mx.init.Xavier())
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05},
+                     mesh=make_mesh())
+    x = rng.rand(256, 784).astype(np.float32)
+    y = rng.randint(0, 10, 256)
+    for _ in range(3):                      # compile + settle
+        float(np.asarray(step(x, y)))
+
+    def one(per_step, i):
+        t0 = time.perf_counter()
+        loss = step(x, y)
+        float(np.asarray(loss))             # close the step like a real loop
+        per_step(i, loss)                   # cost under contract
+        return time.perf_counter() - t0
+
+    from mxnet_tpu.telemetry import watchdog as _wdmod
+
+    noop = lambda i, loss: None             # noqa: E731
+
+    # Informative wall rows: interleaved (alternating pair order, so
+    # neither config owns a slot a periodic background load could
+    # systematically tax), each config's median. Expect these to agree
+    # within this box's noise floor — the contract rows below are the
+    # resolvable measurement.
+    guard = telemetry.NumericGuard(every=1)
+    check = lambda i, loss: guard.check_loss(loss, step=i)  # noqa: E731
+    watchdog = telemetry.HangWatchdog(min_deadline_s=30.0,
+                                      poll_s=0.25).start()
+    base_t, guard_t = [], []
+    try:
+        for i in range(30):
+            for which in ((0, 1) if i % 2 == 0 else (1, 0)):
+                if which == 0:
+                    base_t.append(one(noop, i))
+                else:
+                    guard_t.append(one(check, i))
+    finally:
+        watchdog.close()
+    base_ms = sorted(base_t)[len(base_t) // 2] * 1e3
+    guard_ms = sorted(guard_t)[len(guard_t) // 2] * 1e3
+    _emit("diagnostics_step_ms_base", round(base_ms, 3), "ms")
+    _emit("diagnostics_step_ms_guarded_watchdogged",
+          round(guard_ms, 3), "ms")
+
+    # CONTRACT: numeric guard. Per step (every=1 cadence) the guard
+    # adds exactly one check_loss call; measure it directly against a
+    # settled loss (the real loop checks a loss it reads anyway).
+    loss = step(x, y)
+    float(np.asarray(loss))
+    reps = 2000
+    t0 = time.perf_counter()
+    for i in range(reps):
+        guard.check_loss(loss, step=i)
+    check_ms = (time.perf_counter() - t0) / reps * 1e3
+    _emit("numeric_guard_check_ms", round(check_ms, 5), "ms")
+    _emit("numeric_guard_step_overhead_pct",
+          round(check_ms / base_ms * 100.0, 3), "%")
+
+    # CONTRACT: idle watchdog. Per step the lanes add one begin+end
+    # pair; the 4 Hz scan thread adds scan cost amortized over the
+    # steps that fit in a poll interval.
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _wdmod.begin("step")
+        _wdmod.end("step")
+    hb_ms = (time.perf_counter() - t0) / reps * 1e3
+    scanner = telemetry.HangWatchdog(min_deadline_s=30.0, poll_s=0.25)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        scanner.check()
+    scan_ms = (time.perf_counter() - t0) / reps * 1e3
+    scan_per_step_ms = scan_ms * (base_ms / 1e3) / scanner.poll_s
+    wd_step_ms = hb_ms + scan_per_step_ms
+    _emit("watchdog_heartbeat_ms", round(hb_ms, 5), "ms")
+    _emit("watchdog_scan_ms", round(scan_ms, 5), "ms")
+    _emit("watchdog_idle_overhead_pct",
+          round(wd_step_ms / base_ms * 100.0, 3), "%")
+
+    # Bundle capture cost (off the hot path — paid once per rate-limited
+    # anomaly, at the moment of failure).
+    diag_dir = tempfile.mkdtemp(prefix="bench_diag_")
+    try:
+        recorder = telemetry.FlightRecorder(diag_dir, rank=0)
+        t0 = time.perf_counter()
+        path = recorder.capture("bench", "diagnostics bench capture")
+        capture_ms = (time.perf_counter() - t0) * 1e3
+        size_kb = os.path.getsize(path) / 1e3 if path else 0.0
+        _emit("diag_bundle_capture_ms", round(capture_ms, 3), "ms")
+        _emit("diag_bundle_size_kb", round(size_kb, 1), "KB")
+    finally:
+        shutil.rmtree(diag_dir, ignore_errors=True)
+
+
 def _data_pipeline_rows():
     """Data pipeline section (mxnet_tpu.data, ISSUE 6): per-batch decode
     cost, prefetch overlap, and the step-path input-stall fraction
@@ -740,6 +869,11 @@ def main():
         _telemetry_dist_rows()
     except Exception:
         print("bench telemetry_dist section failed:", file=sys.stderr)
+        traceback.print_exc()
+    try:
+        _diagnostics_rows()
+    except Exception:
+        print("bench diagnostics section failed:", file=sys.stderr)
         traceback.print_exc()
     try:
         _data_pipeline_rows()

@@ -22,10 +22,13 @@ so one executable serves every call with fresh masks.
 """
 from __future__ import annotations
 
+import time
+
 from . import autograd
 from . import random as _random
 from .ops.registry import Operator, _freeze
 from .ndarray.ndarray import NDArray, _wrap_outputs
+from .telemetry import memstats as _ms
 from .telemetry import metrics as _tm
 from .telemetry import trace as _trace
 
@@ -116,15 +119,23 @@ class CachedOp:
 
         from .ops import registry as _reg
 
+        traces_before = self.num_traces
+        t0 = time.perf_counter()
         with _trace.span("cached_op::execute", op=self._op.name):
             if autograd.is_recording():
                 raw = autograd._record_op(self._op, list(args), arrays,
                                           attrs)
                 result = _wrap_outputs(raw, ctx, out=out)
                 autograd._attach_outputs(result)
-                return result
-            raw = _reg.invoke_raw(self._op, arrays, attrs)
-        return _wrap_outputs(raw, ctx, out=out)
+            else:
+                raw = _reg.invoke_raw(self._op, arrays, attrs)
+                result = _wrap_outputs(raw, ctx, out=out)
+        if self.num_traces != traces_before:
+            # This call filled the executable cache (new shape
+            # signature): its wall time is trace + XLA compile — the
+            # compile-accounting seam (mx_compile_seconds).
+            _ms.observe_compile("cached_op", time.perf_counter() - t0)
+        return result
 
     def inference(self, *args, out=None):
         """Eval-mode forward that never records on the autograd tape and
@@ -140,6 +151,10 @@ class CachedOp:
 
         from .ops import registry as _reg
 
+        traces_before = self.num_traces
+        t0 = time.perf_counter()
         with _trace.span("cached_op::inference", op=self._op.name):
             raw = _reg.invoke_raw(self._op, arrays, {"training": False})
+        if self.num_traces != traces_before:
+            _ms.observe_compile("cached_op", time.perf_counter() - t0)
         return _wrap_outputs(raw, ctx, out=out)

@@ -53,6 +53,7 @@ import zlib
 import numpy as np
 
 from ..telemetry import trace as _trace
+from ..telemetry import watchdog as _watchdog
 
 __all__ = ["CheckpointManager", "Shard", "CheckpointNotFoundError",
            "CheckpointCorruptError"]
@@ -292,6 +293,9 @@ class CheckpointManager:
         self._queue = queue.Queue()
         self._thread = None
         self._pending = 0
+        # Per-manager watchdog lane (a lane is a single slot; two
+        # managers sharing "checkpoint" would mask each other's hangs).
+        self._wd_lane = _watchdog.unique_lane("checkpoint")
         self._pending_lock = threading.Lock()
         self._closed = False
 
@@ -404,6 +408,8 @@ class CheckpointManager:
             self._queue.put(None)
             self._thread.join()
             self._thread = None
+        # Release this manager's watchdog lane (see __init__).
+        _watchdog.reset(self._wd_lane)
 
     def __enter__(self):
         return self
@@ -476,6 +482,10 @@ class CheckpointManager:
                 self._queue.task_done()
                 return
             step, snap = item
+            # Watchdog lane: a commit stuck on dead storage (NFS hang,
+            # full disk retry loop) is a `checkpoint_hang` — the writer
+            # thread's stack lands in the diagnostic bundle.
+            _watchdog.begin(self._wd_lane)
             try:
                 self._write_with_retry(step, snap)
             except Exception as exc:  # keep the trainer alive
@@ -483,6 +493,7 @@ class CheckpointManager:
                 self._warn("async checkpoint save for step %d failed: %s"
                            % (step, exc))
             finally:
+                _watchdog.end(self._wd_lane)
                 with self._pending_lock:
                     self._pending -= 1
                 self._bump(self._c_pending, -1)

@@ -162,6 +162,10 @@ class DataPipeline:
         # (the TrainStep._ckpt_view discipline) so a preemption signal
         # handler snapshotting mid-next() sees a consistent position.
         self._ckpt_view = (0, 0)          # (epoch, delivered samples)
+        # The batch most recently handed to the training loop — the
+        # in-flight batch when a step hangs or a loss goes non-finite;
+        # read by debug_state() for flight-recorder bundles.
+        self._last_batch = None
         self._closed = False
 
     # -- geometry -------------------------------------------------------------
@@ -340,6 +344,8 @@ class DataPipeline:
         # Commit the delivered watermark AFTER the batch exists — one
         # bytecode, signal-safe (see TrainStep._ckpt_view).
         end = batch["end_pos"]
+        self._last_batch = {"epoch": batch["epoch"], "end_pos": end,
+                            "ids": batch["ids"]}
         self._ckpt_view = ((batch["epoch"] + 1, 0)
                            if end >= self.samples_per_epoch
                            else (batch["epoch"], end))
@@ -389,6 +395,21 @@ class DataPipeline:
             "batch_size": self.batch_size,
             "ordered": int(self.ordered),
             "fingerprint": repr(self.dataset.fingerprint()),
+        }
+
+    def debug_state(self):
+        """Forensics view (flight-recorder bundles): the delivered-batch
+        watermark plus the sample ids of the batch most recently handed
+        to the training loop — the batch in flight when a step hangs or
+        a loss goes non-finite, i.e. the one to replay."""
+        last = self._last_batch
+        return {
+            "watermark": self.state_dict(),
+            "last_batch": None if last is None else {
+                "epoch": int(last["epoch"]),
+                "end_pos": int(last["end_pos"]),
+                "ids": [int(i) for i in last["ids"]],
+            },
         }
 
     def load_state_dict(self, state):

@@ -68,6 +68,8 @@ storm — `telemetry.StepMonitor.attach_fused` watches it through the
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from . import env as _env
@@ -75,6 +77,7 @@ from .ndarray.ndarray import NDArray
 from .ndarray import sparse as _sp
 from .ops import registry as _reg
 from .ops import optimizer_ops as _oo
+from .telemetry import memstats as _ms
 from .telemetry import metrics as _tm
 from .telemetry import trace as _trace
 
@@ -313,7 +316,7 @@ class _ApplyChunk:
 
     __slots__ = ("exec_fn", "flatten_fn", "shapes", "sizes", "offsets",
                  "n", "k", "flat_w", "flat_s", "weights", "wver",
-                 "views", "state_objs", "stale")
+                 "views", "state_objs", "stale", "compiled")
 
     def __init__(self, exec_fn, flatten_fn, shapes, sizes, offsets, k):
         self.exec_fn = exec_fn
@@ -330,6 +333,7 @@ class _ApplyChunk:
         self.views = []
         self.state_objs = []
         self.stale = True
+        self.compiled = False      # first exec dispatch pays XLA compile
 
 
 class FusedApplier:
@@ -361,6 +365,12 @@ class FusedApplier:
         # StepMonitor.attach_fused chains here to flag signature churn.
         self.num_compiles = 0
         self.on_compile = None
+        # Numeric-health hook (telemetry.NumericGuard.install): when
+        # set and armed for this apply, every chunk's post-apply flat
+        # vector gets one device-side isfinite reduction — O(buckets),
+        # not O(params).
+        self.grad_guard = None
+        self._guard_armed = False
 
     # -- eligibility ----------------------------------------------------------
 
@@ -524,11 +534,20 @@ class FusedApplier:
         # rescale is baked into the executable (see _build_chunk).
         lrs = jnp.asarray(np.asarray(lrs, wdt))
         wds = jnp.asarray(np.asarray(wds, wdt))
+        t_compile = None if ch.compiled else time.perf_counter()
         outs, new_w, new_s = _dispatch(
             "trainer::fused_apply", ch.exec_fn,
             tuple(e[2]._data for e in group), ch.flat_w,
             tuple(ch.flat_s), lrs, wds,
             optimizer=spec.name, params=len(group))
+        if t_compile is not None:
+            # jit compiles synchronously inside the first dispatch (the
+            # execution itself stays async), so this wall time is the
+            # executable-cache fill a persistent compile cache would
+            # delete (mx_compile_seconds{site="fused_apply"}).
+            ch.compiled = True
+            _ms.observe_compile("fused_apply",
+                                time.perf_counter() - t_compile)
         # Inlined _set_data: this commit loop runs once per parameter
         # per step and the engine-mode check hoists out of it.
         naive = _engine.is_naive()
@@ -546,6 +565,17 @@ class FusedApplier:
         for views in ch.views:
             for v in views:
                 v._concrete = None           # value moved under the view
+        if self._guard_armed and self.grad_guard is not None:
+            # One isfinite reduction over the post-apply flat vector: a
+            # NaN/Inf gradient anywhere in the bucket propagates into
+            # the updated weights for every supported (elementwise)
+            # body, so checking the flat weight catches poisoned grads
+            # AND poisoned optimizer math in one O(buckets) pass. The
+            # result stays on device (guard.flush() in apply() is the
+            # single sync point), so the check never serializes the
+            # bucket pipeline.
+            self.grad_guard.check_flat(new_w, optimizer=spec.name,
+                                       params=len(group))
         return []
 
     # -- public ----------------------------------------------------------------
@@ -560,6 +590,10 @@ class FusedApplier:
 
         import jax.numpy as jnp
 
+        # Cadence decision once per apply (not per chunk), so a
+        # guard with every=N checks all of step N's buckets or none.
+        self._guard_armed = (self.grad_guard is not None
+                             and self.grad_guard.arm_apply())
         rescale = float(opt.rescale_grad)
         plan = self._plan
         if plan is not None and plan[0] == spec.name \
@@ -571,6 +605,9 @@ class FusedApplier:
             for gk, ch, group in plan[4]:
                 pending.extend(self._run_chunk(spec, gk, ch, group, opt,
                                                jnp))
+            if self._guard_armed and self.grad_guard is not None:
+                # Single sync point AFTER every bucket dispatched.
+                self.grad_guard.flush()
             return pending
 
         states = self.updater.states
@@ -611,6 +648,9 @@ class FusedApplier:
         pending = list(pending)
         for gk, ch, part in chunks:
             pending.extend(self._run_chunk(spec, gk, ch, part, opt, jnp))
+        if self._guard_armed and self.grad_guard is not None:
+            # Single sync point AFTER every bucket dispatched.
+            self.grad_guard.flush()
         return pending
 
 

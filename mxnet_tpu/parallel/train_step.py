@@ -25,8 +25,10 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random
+from ..telemetry import memstats as _ms
 from ..telemetry import metrics as _tm
 from ..telemetry import trace as _trace
+from ..telemetry import watchdog as _watchdog
 from ..ndarray.ndarray import NDArray
 from ..gluon.parameter import override
 from .mesh import make_mesh, data_sharding, replicate, shard_params, \
@@ -124,6 +126,7 @@ class TrainStep:
         self._jitted = None
         self._materialized = False
         self._multiproc = False
+        self._compile_pending = False
 
     def _make_opt_rule(self):
         """(n_states, update_fn) for the configured optimizer.
@@ -570,6 +573,7 @@ class TrainStep:
         self._jitted = jax.jit(step, in_shardings=in_shardings,
                                out_shardings=out_shardings,
                                donate_argnums=(0, 1, 2))
+        self._compile_pending = True
 
     # -- public API -----------------------------------------------------------
 
@@ -583,52 +587,69 @@ class TrainStep:
         feeds its own `num_parts`/`part_index` shard of the epoch.
         """
         t_start = time.perf_counter()
-        if isinstance(x, NDArray):
-            x = x._data
-        if isinstance(y, NDArray):
-            y = y._data
-        if not self._materialized:
-            self._materialize(np.asarray(x)[:1])
-        if self._jitted is None:
-            self._build()
-        with _trace.span("train_step::data_put"):
+        # Heartbeat lane for the hang watchdog: in-flight work between
+        # begin/end past its deadline fires a `step_hang` anomaly with
+        # this thread's stack in the bundle.
+        _watchdog.begin("step")
+        try:
+            if isinstance(x, NDArray):
+                x = x._data
+            if isinstance(y, NDArray):
+                y = y._data
+            if not self._materialized:
+                self._materialize(np.asarray(x)[:1])
+            if self._jitted is None:
+                self._build()
+            with _trace.span("train_step::data_put"):
+                if self._multiproc:
+                    x = jax.make_array_from_process_local_data(
+                        self._data_sharding, np.asarray(x))
+                    y = jax.make_array_from_process_local_data(
+                        self._data_sharding, np.asarray(y))
+                else:
+                    x = jax.device_put(jnp.asarray(x),
+                                       self._data_sharding)
+                    y = jax.device_put(jnp.asarray(y),
+                                       self._data_sharding)
+            t = self.num_update + 1
+            key = _random.next_key()
+            # The dispatch span covers fwd+bwd+grad-sync+update as one
+            # fused executable; grad-sync is the psum XLA inserted
+            # inside it, so its device-side cost is only separable in
+            # the XPlane trace.
+            with _trace.span("train_step::dispatch", step=t):
+                new_p, new_s, new_a, loss = self._jitted(
+                    self._param_vals, self._opt_state, self._aux_vals,
+                    x, y, jnp.float32(self.lr), jnp.float32(t), key)
+            # Single-bytecode commit of everything a checkpoint reads: a
+            # signal handler (checkpoint.PreemptionHook) can interrupt
+            # between any two statements here, and snapshotting params
+            # from step N with the counter/RNG of step N+1 would
+            # silently lose an update on resume. state_dict() reads
+            # THIS tuple.
+            self._ckpt_view = (new_p, new_s, new_a, t,
+                               _random.get_state())
+            self._param_vals, self._opt_state, self._aux_vals = \
+                new_p, new_s, new_a
+            self.num_update = t
+            t_end = time.perf_counter()
+            _trace.complete("train_step::step", t_start, t_end, step=t)
+            _step_seconds.observe(t_end - t_start)
+            _steps_total.inc()
+            if self._compile_pending:
+                # First call after a build pays whole-step trace + XLA
+                # compile — the compile-accounting seam.
+                self._compile_pending = False
+                _ms.observe_compile("train_step", t_end - t_start)
             if self._multiproc:
-                x = jax.make_array_from_process_local_data(
-                    self._data_sharding, np.asarray(x))
-                y = jax.make_array_from_process_local_data(
-                    self._data_sharding, np.asarray(y))
-            else:
-                x = jax.device_put(jnp.asarray(x), self._data_sharding)
-                y = jax.device_put(jnp.asarray(y), self._data_sharding)
-        t = self.num_update + 1
-        key = _random.next_key()
-        # The dispatch span covers fwd+bwd+grad-sync+update as one fused
-        # executable; grad-sync is the psum XLA inserted inside it, so
-        # its device-side cost is only separable in the XPlane trace.
-        with _trace.span("train_step::dispatch", step=t):
-            new_p, new_s, new_a, loss = self._jitted(
-                self._param_vals, self._opt_state, self._aux_vals, x, y,
-                jnp.float32(self.lr), jnp.float32(t), key)
-        # Single-bytecode commit of everything a checkpoint reads: a
-        # signal handler (checkpoint.PreemptionHook) can interrupt
-        # between any two statements here, and snapshotting params from
-        # step N with the counter/RNG of step N+1 would silently lose an
-        # update on resume. state_dict() reads THIS tuple.
-        self._ckpt_view = (new_p, new_s, new_a, t, _random.get_state())
-        self._param_vals, self._opt_state, self._aux_vals = \
-            new_p, new_s, new_a
-        self.num_update = t
-        t_end = time.perf_counter()
-        _trace.complete("train_step::step", t_start, t_end, step=t)
-        _step_seconds.observe(t_end - t_start)
-        _steps_total.inc()
-        if self._multiproc:
-            # The replicated loss is not fully addressable from one
-            # controller; hand back this process's local replica so the
-            # return type (a scalar jax array) matches single-process
-            # and dispatch stays async.
-            return loss.addressable_data(0)
-        return loss
+                # The replicated loss is not fully addressable from one
+                # controller; hand back this process's local replica so
+                # the return type (a scalar jax array) matches
+                # single-process and dispatch stays async.
+                return loss.addressable_data(0)
+            return loss
+        finally:
+            _watchdog.end("step")
 
     def set_learning_rate(self, lr):
         self.lr = float(lr)

@@ -27,6 +27,7 @@ from .. import ndarray as nd
 from ..cached_op import CachedOp
 from ..ndarray.ndarray import NDArray
 from ..telemetry import trace as _trace
+from ..telemetry import watchdog as _watchdog
 from .admission import AdmissionController
 from .batcher import DynamicBatcher
 from .buckets import BucketPolicy
@@ -128,6 +129,9 @@ class InferenceServer:
         self.policy = BucketPolicy(max_batch=max_batch, buckets=buckets)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._warmed = set()
+        # Per-server watchdog lane: a lane is a single slot, so two
+        # servers sharing "serving" would mask each other's hangs.
+        self._wd_lane = _watchdog.unique_lane("serving")
         # Serializes device calls: warmup() on an already-started server
         # must not race the worker through the model's executor cache.
         self._model_lock = threading.Lock()
@@ -189,6 +193,9 @@ class InferenceServer:
 
     def shutdown(self, drain=True, timeout=None):
         self._batcher.shutdown(drain=drain, timeout=timeout)
+        # Release this server's watchdog lane so long-lived processes
+        # cycling servers don't accumulate dead lanes.
+        _watchdog.reset(self._wd_lane)
 
     def __enter__(self):
         return self
@@ -234,6 +241,16 @@ class InferenceServer:
     def _run_batch(self, requests, bucket):
         """Assemble+pad the bucket batch, ONE device call, unpad per
         request. Runs on the batcher worker thread."""
+        # Watchdog lane: a device call (or executor rebuild) that wedges
+        # stalls the whole queue drain — that is a `serving_hang`, with
+        # this worker thread's stack in the diagnostic bundle.
+        _watchdog.begin(self._wd_lane)
+        try:
+            self._run_batch_inner(requests, bucket)
+        finally:
+            _watchdog.end(self._wd_lane)
+
+    def _run_batch_inner(self, requests, bucket):
         t0 = time.perf_counter()
         batch = np.zeros((bucket,) + self._item_shape, self._dtype)
         spans, off = [], 0

@@ -43,7 +43,8 @@ Pod scale (ISSUE 5) adds four more modules on the same registry/rings:
 
 * :mod:`.aggregate` — per-rank registry snapshots pushed over the
   kvstore command channel and merged by rank 0 into one fleet registry
-  (every series labeled by ``rank``, silent ranks marked stale), so ONE
+  (every series labeled by ``rank``, silent ranks marked stale, and a
+  ``sum without (rank)`` merged series per histogram family), so ONE
   scrape shows the whole pod.
 * :mod:`.export` — streaming span export: the rings are drained on a
   size/age rotation budget into immutable, atomically committed
@@ -53,8 +54,28 @@ Pod scale (ISSUE 5) adds four more modules on the same registry/rings:
   histogram families, ``mx_slo_burn_rate{slo,window}`` gauges and
   rate-limited alerts.
 * :mod:`.flamegraph` — pprof-style top-K self-time table
-  (``profiler.dumps(format="top")``) and collapsed-stack output for
-  standard flamegraph tooling.
+  (``profiler.dumps(format="top")``), collapsed-stack output for
+  standard flamegraph tooling, and capture diffing
+  (``diff_top``/``tools/flame_diff.py``).
+
+Failure forensics (ISSUE 7) turns detection into evidence:
+
+* :mod:`.recorder` — the flight recorder: anomaly-triggered, atomically
+  committed ``diag.rank<R>.<SEQ>.json`` bundles (thread stacks, last-N
+  spans, registry snapshot + exemplars, anomaly history, data batch
+  provenance, watchdog lanes, device memory, compile accounting, env);
+  ``tools/diagnose.py`` summarizes and merges them.
+* :mod:`.watchdog` — heartbeat lanes in training / serving / the
+  checkpoint writer plus a :class:`HangWatchdog` that turns in-flight
+  work past ``max(deadline, K×EWMA)`` into ``*_hang`` anomalies (and
+  bundles).
+* :mod:`.numerics` — opt-in cadence-gated ``isfinite`` guards on the
+  loss and on the fused update's flat buckets (O(buckets) device-side
+  reductions); violations raise ``nonfinite`` anomalies carrying
+  step/batch-id provenance, optionally halting the job.
+* :mod:`.memstats` — ``mx_device_live_bytes``/``_buffers``/peak gauges
+  sampled from the backend, and ``mx_compile_seconds{site}`` fed by the
+  CachedOp / fused-apply / TrainStep executable-cache-fill seams.
 """
 from __future__ import annotations
 
@@ -64,20 +85,31 @@ from . import aggregate
 from . import export
 from . import flamegraph
 from . import slo
+from . import memstats
+from . import watchdog
+from . import recorder
+from . import numerics
 from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
                       render_prometheus, start_http_server,
-                      default_buckets)
+                      default_buckets, set_exemplars)
 from .health import StepMonitor
 from .aggregate import Aggregator, LocalBus
 from .export import StreamingTraceWriter
 from .slo import BurnRateMonitor, ServiceLevelObjective
+from .recorder import FlightRecorder
+from .watchdog import HangWatchdog
+from .numerics import NumericGuard, NonFiniteError
+from .memstats import DeviceMemoryMonitor
 
 __all__ = ["metrics", "trace", "aggregate", "export", "flamegraph",
-           "slo", "Registry", "REGISTRY", "counter", "gauge",
+           "slo", "memstats", "watchdog", "recorder", "numerics",
+           "Registry", "REGISTRY", "counter", "gauge",
            "histogram", "render_prometheus", "start_http_server",
-           "default_buckets", "StepMonitor", "Aggregator", "LocalBus",
-           "StreamingTraceWriter", "BurnRateMonitor",
-           "ServiceLevelObjective", "set_enabled", "enabled"]
+           "default_buckets", "set_exemplars", "StepMonitor",
+           "Aggregator", "LocalBus", "StreamingTraceWriter",
+           "BurnRateMonitor", "ServiceLevelObjective", "FlightRecorder",
+           "HangWatchdog", "NumericGuard", "NonFiniteError",
+           "DeviceMemoryMonitor", "set_enabled", "enabled"]
 
 
 def set_enabled(on):

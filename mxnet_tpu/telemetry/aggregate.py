@@ -112,12 +112,19 @@ def _merge_family(fleet, kind, fam_snap, rank):
                 child._value = rec
 
 
-def merge_snapshots(snaps):
+def merge_snapshots(snaps, merged_rank="all"):
     """Merge ``{rank: snapshot}`` into a fresh fleet
     :class:`~mxnet_tpu.telemetry.metrics.Registry` with every series
     labeled by its source rank. Families that collide across ranks with
     incompatible declarations are skipped (warned rate-limited) rather
-    than failing the whole merge."""
+    than failing the whole merge.
+
+    Histogram families additionally get a ``sum without (rank)`` merged
+    view: for every child label set, the per-rank bucket vectors /
+    sum / count / extrema are summed into one extra series labeled
+    ``rank=<merged_rank>`` (default ``"all"``; pass None to skip), so
+    fleet-wide p50/p99 derive from ONE series instead of N per-rank
+    quantiles that cannot be averaged."""
     fleet = _metrics.Registry()
     for rank in sorted(snaps):
         snap = snaps[rank]
@@ -132,7 +139,62 @@ def merge_snapshots(snaps):
                         "aggregate:merge:%s" % fam_snap.get("name"),
                         300.0, "fleet merge skipped %r: %s",
                         fam_snap.get("name"), exc)
+    if merged_rank is not None:
+        _merge_histogram_totals(fleet, snaps, str(merged_rank))
     return fleet
+
+
+def _merge_histogram_totals(fleet, snaps, merged_rank):
+    """The registry-side ``sum without (rank)`` pass: accumulate every
+    histogram child's raw bucket counts across ranks and write the total
+    as one extra ``rank=<merged_rank>`` series. Children whose bucket
+    vector length drifted from the declared bounds are skipped exactly
+    like the per-rank merge skips them."""
+    totals = {}          # (name, labels, buckets, values) -> accum
+    for rank in sorted(snaps):
+        for fam_snap in snaps[rank].get("histograms", ()):
+            buckets = tuple(fam_snap["buckets"])
+            labels = tuple(fam_snap["labels"])
+            for values, rec in fam_snap["children"]:
+                if len(rec["counts"]) != len(buckets) + 1:
+                    continue
+                key = (fam_snap["name"], labels, buckets, tuple(values))
+                acc = totals.get(key)
+                if acc is None:
+                    totals[key] = {
+                        "help": fam_snap["help"],
+                        "counts": list(rec["counts"]),
+                        "sum": rec["sum"], "count": rec["count"],
+                        "min": math.inf if rec["min"] is None
+                        else rec["min"],
+                        "max": -math.inf if rec["max"] is None
+                        else rec["max"]}
+                else:
+                    acc["counts"] = [a + b for a, b in
+                                     zip(acc["counts"], rec["counts"])]
+                    acc["sum"] += rec["sum"]
+                    acc["count"] += rec["count"]
+                    if rec["min"] is not None:
+                        acc["min"] = min(acc["min"], rec["min"])
+                    if rec["max"] is not None:
+                        acc["max"] = max(acc["max"], rec["max"])
+    for (name, labels, buckets, values), acc in totals.items():
+        rlabel = _rank_label(labels)
+        try:
+            family = fleet.histogram(name, acc["help"],
+                                     labels + (rlabel,),
+                                     buckets=list(buckets))
+        except ValueError:
+            continue    # incompatible redeclaration, warned above
+        labelvalues = dict(zip(labels, values))
+        labelvalues[rlabel] = merged_rank
+        child = family.labels(**labelvalues)
+        with child._lock:
+            child._counts = list(acc["counts"])
+            child._sum = acc["sum"]
+            child._count = acc["count"]
+            child._min = acc["min"]
+            child._max = acc["max"]
 
 
 # -- in-process transport -----------------------------------------------------
@@ -295,13 +357,35 @@ class Aggregator:
         with self._lock:
             return self._fleet
 
-    def render_prometheus(self):
+    def merged_quantile(self, name, q, **labels):
+        """Fleet-wide quantile of a histogram family from its
+        ``sum without (rank)`` merged series (the ``rank="all"`` child
+        the merge adds) — one honest pod p50/p99 instead of N per-rank
+        quantiles. Returns None before the first merge or when the
+        family/child does not exist."""
+        fleet = self.fleet
+        if fleet is None:
+            return None
+        fam = fleet.get(name)
+        if fam is None or fam.kind != "histogram":
+            return None
+        rlabel = "src_rank" if "src_rank" in fam.labelnames else "rank"
+        labels[rlabel] = "all"
+        try:
+            key = tuple(str(labels[l]) for l in fam.labelnames)
+        except KeyError:
+            return None
+        child = fam._children.get(key)   # no get-or-create side effect
+        return None if child is None else child.quantile(q)
+
+    def render_prometheus(self, openmetrics=False):
         """Prometheus exposition of the fleet (so the aggregator itself
         can be passed as ``registry=`` to ``start_http_server``). Before
         the first merge — or on non-zero ranks — falls back to the local
         registry, so a scrape is never a 500."""
         fleet = self.fleet
-        return (fleet or self._registry).render_prometheus()
+        return (fleet or self._registry).render_prometheus(
+            openmetrics=openmetrics)
 
     # -- background mode ------------------------------------------------------
 

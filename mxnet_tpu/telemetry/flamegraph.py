@@ -29,7 +29,8 @@ from __future__ import annotations
 from . import metrics as _metrics
 from . import trace as _trace
 
-__all__ = ["top", "render_top", "collapsed", "dump_collapsed"]
+__all__ = ["top", "render_top", "collapsed", "dump_collapsed",
+           "diff_top", "render_diff"]
 
 # Clock-granularity slack when deciding whether one span nests inside
 # another (µs; perf_counter is ns-resolution but float µs rounding can
@@ -145,3 +146,92 @@ def dump_collapsed(path, trace_data=None):
 
     _export.commit_bytes(path, collapsed(trace_data).encode("utf-8"))
     return path
+
+
+# -- diffing two captures -----------------------------------------------------
+
+def _parse_collapsed(capture):
+    """``{stack_path: self_us}`` from a collapsed capture: a string of
+    ``stack self_us`` lines (what :func:`collapsed` / a capture file
+    holds) or an already-folded dict. Unparsable lines are skipped —
+    a diff of a crashed job's capture must succeed on what committed."""
+    if isinstance(capture, dict):
+        return {str(k): float(v) for k, v in capture.items()}
+    folded = {}
+    for line in str(capture).splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        path, _, us = line.rpartition(" ")
+        if not path:
+            continue
+        try:
+            folded[path] = folded.get(path, 0.0) + float(us)
+        except ValueError:
+            continue
+    return folded
+
+
+def _by_leaf(folded):
+    """Fold full stacks down to leaf-frame self time (the op/span that
+    actually burned the cycles, regardless of which thread or caller it
+    ran under — two captures rarely share exact thread/stack shapes)."""
+    leaf = {}
+    for path, us in folded.items():
+        name = path.rsplit(";", 1)[-1]
+        leaf[name] = leaf.get(name, 0.0) + us
+    return leaf
+
+
+def diff_top(before, after, k=20, min_share=0.001):
+    """Self-time **share** regressions between two collapsed captures.
+
+    Each capture is normalized to its own total (absolute wall time is
+    not comparable across runs of different length), folded to leaf
+    frames, and compared: a row per op whose share of total self time
+    moved, sorted worst regression first. Returns up to ``k`` rows
+    ``{op, before_us, after_us, before_share, after_share, delta_pp}``
+    (``delta_pp`` = after minus before share, in percentage points;
+    positive = regressed). Ops below ``min_share`` in BOTH captures are
+    noise and dropped."""
+    b_leaf = _by_leaf(_parse_collapsed(before))
+    a_leaf = _by_leaf(_parse_collapsed(after))
+    b_total = sum(b_leaf.values()) or 1.0
+    a_total = sum(a_leaf.values()) or 1.0
+    rows = []
+    for op in set(b_leaf) | set(a_leaf):
+        bs = b_leaf.get(op, 0.0) / b_total
+        as_ = a_leaf.get(op, 0.0) / a_total
+        if bs < min_share and as_ < min_share:
+            continue
+        rows.append({
+            "op": op,
+            "before_us": b_leaf.get(op, 0.0),
+            "after_us": a_leaf.get(op, 0.0),
+            "before_share": bs,
+            "after_share": as_,
+            "delta_pp": (as_ - bs) * 100.0,
+        })
+    rows.sort(key=lambda r: r["delta_pp"], reverse=True)
+    return rows[:int(k)]
+
+
+def render_diff(before, after, k=20, min_share=0.001):
+    """Human table over :func:`diff_top` — regressions first, flagged
+    when an op's self-time share grew by more than one point."""
+    rows = diff_top(before, after, k=k, min_share=min_share)
+    lines = [
+        "Self-time share diff (worst regression first)",
+        "%-40s %12s %8s %12s %8s %9s"
+        % ("Op", "Before(ms)", "Share", "After(ms)", "Share", "Delta"),
+    ]
+    for r in rows:
+        flag = "  << REGRESSED" if r["delta_pp"] > 1.0 else ""
+        lines.append(
+            "%-40s %12.3f %7.1f%% %12.3f %7.1f%% %+8.2fpp%s"
+            % (r["op"], r["before_us"] / 1e3, r["before_share"] * 100.0,
+               r["after_us"] / 1e3, r["after_share"] * 100.0,
+               r["delta_pp"], flag))
+    if not rows:
+        lines.append("(no overlapping self time above the noise floor)")
+    return "\n".join(lines)

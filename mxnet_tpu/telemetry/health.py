@@ -74,6 +74,12 @@ class StepMonitor:
         self._steps = 0
         self._managers = []
         self.anomaly_counts = {}    # kind -> count (this monitor)
+        # Anomaly observers (kind, msg): the flight recorder's
+        # subscription seam (telemetry.recorder.FlightRecorder.attach).
+        # Observers run inline on the detecting thread — at the moment
+        # of failure, before the evidence is gone — and must never take
+        # down the loop, so each callback is exception-isolated.
+        self.on_anomaly = []
         self._anomalies = _metrics.REGISTRY.counter(
             "mx_anomalies_total",
             "Step-health anomalies detected by telemetry.StepMonitor",
@@ -206,6 +212,37 @@ class StepMonitor:
                 self._ewma * 1e3,
                 "anomalies": dict(self.anomaly_counts)}
 
+    # -- checkpoint/restore of the detection baseline -------------------------
+
+    def state_dict(self):
+        """The detection baseline (step count + step-time EWMA) as small
+        scalars, suitable for riding inside a CheckpointManager state
+        tree next to the training state."""
+        return {"kind": "step_monitor", "steps": self._steps,
+                "ewma": self._ewma}
+
+    def load_state_dict(self, state, rearm_warmup=True):
+        """Seed the baseline from a :meth:`state_dict` snapshot. With
+        ``rearm_warmup`` (the default) the step counter restarts at 0 so
+        slow-step detection re-arms only after ``warmup_steps`` fresh
+        observations: the first post-resume step pays restore + XLA
+        recompile cost and would otherwise flag itself as a ``slow_step``
+        outlier against the steady-state EWMA it had no part in. The
+        restored EWMA still seeds the baseline, so detection converges
+        in warmup_steps instead of from scratch."""
+        self._ewma = None if state.get("ewma") is None \
+            else float(state["ewma"])
+        self._steps = 0 if rearm_warmup else int(state.get("steps", 0))
+
+    def reset_baseline(self, keep_ewma=False):
+        """Re-enter warmup (checkpoint restore with no saved monitor
+        state): detection disarms for ``warmup_steps`` observations and
+        — unless ``keep_ewma`` — the EWMA rebuilds from the post-resume
+        regime."""
+        self._steps = 0
+        if not keep_ewma:
+            self._ewma = None
+
     def record_anomaly(self, kind, msg):
         """Public anomaly entry for external detectors (aggregation
         rank-staleness, SLO burn alerts): counts into
@@ -225,6 +262,15 @@ class StepMonitor:
             self._logger, "step_monitor:%d:%s" % (id(self), kind),
             self.warn_interval_s, "[telemetry:%s] %s", kind, msg,
             now=self._clock())
+        for callback in list(self.on_anomaly):
+            try:
+                callback(kind, msg)
+            except Exception as exc:   # forensics never kills the loop
+                _log.warn_rate_limited(
+                    self._logger,
+                    "step_monitor:observer:%d" % id(callback), 30.0,
+                    "anomaly observer failed: %s", exc,
+                    now=self._clock())
 
 
 class _MonitoredStep:

@@ -31,12 +31,14 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from bisect import bisect_left
 
 __all__ = ["Registry", "CounterFamily", "GaugeFamily", "HistogramFamily",
            "MetricsServer", "REGISTRY", "counter", "gauge", "histogram",
            "render_prometheus", "start_http_server", "set_enabled",
-           "enabled", "default_buckets"]
+           "enabled", "default_buckets", "set_exemplars",
+           "exemplars_enabled", "collect_exemplars"]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -60,6 +62,42 @@ def set_enabled(on):
 
 def enabled():
     return _enabled[0]
+
+
+# Exemplar flag + span-id source. Behind a flag because every observe()
+# pays one extra check (and, when a span is open, a tuple store) — the
+# default hot path is untouched.
+_exemplars = [False]
+_span_source = [None]
+
+
+def set_exemplars(on, span_source=None):
+    """Enable OpenMetrics exemplars: each ``Histogram.observe()`` that
+    runs inside an open trace span records (span id, value, wall time)
+    for the bucket it landed in, and ``render_prometheus(
+    openmetrics=True)`` — which the ``/metrics`` endpoint serves to
+    scrapers whose Accept header asks for OpenMetrics — appends
+    ``# {span_id="..."} value ts`` to that ``_bucket`` line: the link
+    from a p99 bucket to the exact span that caused it. The classic
+    0.0.4 exposition never carries them (exemplar syntax there fails
+    the whole scrape). Enabling also turns on
+    :func:`mxnet_tpu.telemetry.trace.set_span_ids` (the id source)
+    unless a custom ``span_source`` callable is given. Returns the
+    previous state; disabling leaves span ids as they are."""
+    prev = _exemplars[0]
+    if on:
+        if span_source is None:
+            from . import trace as _trace
+
+            _trace.set_span_ids(True)
+            span_source = _trace.current_span_id
+        _span_source[0] = span_source
+    _exemplars[0] = bool(on)
+    return prev
+
+
+def exemplars_enabled():
+    return _exemplars[0]
 
 
 def default_buckets(start=1e-4, factor=2.0, count=21):
@@ -148,7 +186,7 @@ class _GaugeChild:
 
 class _HistogramChild:
     __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count",
-                 "_min", "_max")
+                 "_min", "_max", "_ex")
 
     def __init__(self, bounds):
         self._lock = threading.Lock()
@@ -158,11 +196,18 @@ class _HistogramChild:
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        self._ex = None          # per-bucket (span_id, value, wall_ts)
 
     def observe(self, value):
         if not _enabled[0]:
             return
         idx = bisect_left(self._bounds, value)
+        ex = None
+        if _exemplars[0]:
+            src = _span_source[0]
+            sid = src() if src is not None else None
+            if sid is not None:
+                ex = (sid, value, time.time())
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
@@ -171,6 +216,10 @@ class _HistogramChild:
                 self._min = value
             if value > self._max:
                 self._max = value
+            if ex is not None:
+                if self._ex is None:
+                    self._ex = [None] * (len(self._bounds) + 1)
+                self._ex[idx] = ex
 
     @property
     def count(self):
@@ -184,20 +233,22 @@ class _HistogramChild:
 
     def snapshot(self):
         """Consistent point-in-time view: {'count', 'sum', 'min', 'max',
-        'buckets': [(upper_bound, cumulative_count), ..., (inf, count)]}.
+        'buckets': [(upper_bound, cumulative_count), ..., (inf, count)],
+        'exemplars': per-bucket (span_id, value, wall_ts) or None}.
         min/max are None when empty."""
         with self._lock:
             counts = list(self._counts)
             total, s = self._count, self._sum
             mn = None if self._count == 0 else self._min
             mx = None if self._count == 0 else self._max
+            ex = None if self._ex is None else list(self._ex)
         cum, buckets = 0, []
         for bound, c in zip(self._bounds, counts):
             cum += c
             buckets.append((bound, cum))
         buckets.append((math.inf, cum + counts[-1]))
         return {"count": total, "sum": s, "min": mn, "max": mx,
-                "buckets": buckets}
+                "buckets": buckets, "exemplars": ex}
 
     def quantile(self, q):
         """Estimate the q-quantile (0 <= q <= 1) by linear interpolation
@@ -405,8 +456,15 @@ class Registry:
         with self._lock:
             return list(self._families.values())
 
-    def render_prometheus(self):
-        """Prometheus text exposition (format 0.0.4) of every family."""
+    def render_prometheus(self, openmetrics=False):
+        """Text exposition of every family. Default: the classic
+        Prometheus format 0.0.4. With ``openmetrics=True``: an
+        OpenMetrics-flavored rendering that additionally carries
+        recorded exemplars on ``_bucket`` lines and the required
+        ``# EOF`` terminator — exemplar syntax is ONLY valid there (a
+        classic-format scraper rejects the whole scrape on it), which
+        is why the ``/metrics`` endpoint negotiates via the Accept
+        header instead of always emitting them."""
         out = []
         for fam in self.collect():
             out.append("# HELP %s %s" % (fam.name, _esc_help(fam.help)))
@@ -415,13 +473,23 @@ class Registry:
                 base = _labelstr(fam.labelnames, values)
                 if fam.kind == "histogram":
                     snap = child.snapshot()
-                    for bound, cum in snap["buckets"]:
+                    exemplars = snap.get("exemplars") if openmetrics \
+                        else None
+                    for i, (bound, cum) in enumerate(snap["buckets"]):
                         le = "+Inf" if math.isinf(bound) else _fmt(bound)
-                        out.append("%s_bucket%s %d" % (
+                        line = "%s_bucket%s %d" % (
                             fam.name,
                             _labelstr(fam.labelnames + ("le",),
                                       values + (le,)),
-                            cum))
+                            cum)
+                        ex = exemplars[i] if exemplars else None
+                        if ex is not None:
+                            # OpenMetrics exemplar: the trace span that
+                            # fed this bucket (metrics.set_exemplars).
+                            line += ' # {span_id="%s"} %s %s' % (
+                                _esc_label(str(ex[0])), _fmt(ex[1]),
+                                _fmt(ex[2]))
+                        out.append(line)
                     out.append("%s_sum%s %s" % (fam.name, base,
                                                 _fmt(snap["sum"])))
                     out.append("%s_count%s %d" % (fam.name, base,
@@ -429,6 +497,8 @@ class Registry:
                 else:
                     out.append("%s%s %s" % (fam.name, base,
                                             _fmt(child.value)))
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 
@@ -478,8 +548,35 @@ def histogram(name, help="", labels=(), buckets=None, registry=None):
                                             buckets=buckets)
 
 
-def render_prometheus(registry=None):
-    return (registry or REGISTRY).render_prometheus()
+def render_prometheus(registry=None, openmetrics=False):
+    return (registry or REGISTRY).render_prometheus(
+        openmetrics=openmetrics)
+
+
+def collect_exemplars(registry=None):
+    """All recorded exemplars as a plain JSON-able list (the flight
+    recorder's bundle view): ``[{metric, labels, le, span_id, value,
+    ts}]``. Empty when exemplars are disabled or nothing observed inside
+    a span yet."""
+    reg = registry or REGISTRY
+    out = []
+    for fam in reg.collect():
+        if fam.kind != "histogram":
+            continue
+        for values, child in fam.collect():
+            snap = child.snapshot()
+            exemplars = snap.get("exemplars")
+            if not exemplars:
+                continue
+            for (bound, _), ex in zip(snap["buckets"], exemplars):
+                if ex is None:
+                    continue
+                out.append({
+                    "metric": fam.name,
+                    "labels": dict(zip(fam.labelnames, values)),
+                    "le": "+Inf" if math.isinf(bound) else bound,
+                    "span_id": ex[0], "value": ex[1], "ts": ex[2]})
+    return out
 
 
 class MetricsServer:
@@ -551,10 +648,25 @@ def start_http_server(port=0, addr="127.0.0.1", registry=None):
             if self.path.split("?", 1)[0] not in ("/metrics", "/"):
                 self.send_error(404)
                 return
-            body = reg.render_prometheus().encode("utf-8")
+            # Content negotiation: exemplars are only legal in the
+            # OpenMetrics format, so they are emitted ONLY to scrapers
+            # that ask for it — a classic-format scraper keeps getting
+            # clean 0.0.4 text (exemplar syntax there fails the whole
+            # scrape).
+            accept = self.headers.get("Accept", "") or ""
+            openmetrics = "application/openmetrics-text" in accept
+            try:
+                body = reg.render_prometheus(
+                    openmetrics=openmetrics).encode("utf-8")
+            except TypeError:   # registry-shaped duck without the kwarg
+                openmetrics = False
+                body = reg.render_prometheus().encode("utf-8")
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header(
+                "Content-Type",
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8" if openmetrics
+                else "text/plain; version=0.0.4; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -37,6 +37,7 @@ check) — the tracing half of the telemetry overhead contract.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -45,7 +46,8 @@ from collections import deque
 
 __all__ = ["span", "instant", "complete", "chrome_trace", "dump",
            "drain", "clear", "set_enabled", "enabled", "set_capacity",
-           "capacity", "event_count"]
+           "capacity", "event_count", "set_span_ids", "span_ids_enabled",
+           "current_span_id"]
 
 _DEFAULT_CAPACITY = 16384
 # Rings of dead threads retained for the next flush (most recent first
@@ -54,10 +56,14 @@ _DEFAULT_CAPACITY = 16384
 # accumulate one ring per connection forever).
 _MAX_DEAD_RINGS = 32
 
-_state = {"enabled": True, "capacity": _DEFAULT_CAPACITY}
+_state = {"enabled": True, "capacity": _DEFAULT_CAPACITY,
+          "span_ids": False}
 _registry_lock = threading.Lock()
 _rings = []            # [(thread, deque), ...]
 _tls = threading.local()
+# Process-unique span ids (itertools.count.__next__ is atomic under the
+# GIL, so no lock on the span hot path).
+_span_counter = itertools.count(1)
 
 
 def set_enabled(on):
@@ -80,6 +86,30 @@ def set_capacity(n):
 
 def capacity():
     return _state["capacity"]
+
+
+def set_span_ids(on):
+    """Enable per-span ids: every open ``span()`` gets a process-unique
+    hex id, readable via :func:`current_span_id` while the span is open
+    and carried in the emitted event's args as ``span_id``. This is the
+    link exemplars (``metrics.set_exemplars``) and diagnostic bundles
+    use to point from a histogram bucket back to the exact trace span
+    that fed it. Off by default (one extra append/pop per span when on).
+    Returns the previous state."""
+    prev = _state["span_ids"]
+    _state["span_ids"] = bool(on)
+    return prev
+
+
+def span_ids_enabled():
+    return _state["span_ids"]
+
+
+def current_span_id():
+    """Id of the innermost open span on THIS thread, or None (also None
+    when span ids are disabled — see :func:`set_span_ids`)."""
+    stack = getattr(_tls, "span_ids", None)
+    return stack[-1] if stack else None
 
 
 def _prune_locked():
@@ -106,22 +136,43 @@ class _Span:
     """Context manager recording one complete event on exit. Cheap when
     tracing is disabled: no clock read, no ring append."""
 
-    __slots__ = ("_name", "_args", "_t0")
+    __slots__ = ("_name", "_args", "_t0", "_id")
 
     def __init__(self, name, args):
         self._name = name
         self._args = args
 
     def __enter__(self):
-        self._t0 = time.perf_counter() if _state["enabled"] else None
+        self._id = None
+        if _state["enabled"]:
+            if _state["span_ids"]:
+                sid = "%x" % next(_span_counter)
+                stack = getattr(_tls, "span_ids", None)
+                if stack is None:
+                    stack = _tls.span_ids = []
+                stack.append(sid)
+                self._id = sid
+            self._t0 = time.perf_counter()
+        else:
+            self._t0 = None
         return self
 
     def __exit__(self, *exc):
         t0 = self._t0
+        if self._id is not None:
+            # Spans are context-managed, so the per-thread id stack is
+            # strictly LIFO.
+            stack = getattr(_tls, "span_ids", None)
+            if stack:
+                stack.pop()
         if t0 is not None:
             t1 = time.perf_counter()
+            args = self._args
+            if self._id is not None:
+                args = dict(args) if args else {}
+                args["span_id"] = self._id
             _ring().append(("X", self._name, t0 * 1e6, (t1 - t0) * 1e6,
-                            self._args))
+                            args))
         return False
 
 

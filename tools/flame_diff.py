@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Diff two collapsed flamegraph captures: which ops' self-time share
+regressed?
+
+`mxnet_tpu.telemetry.flamegraph.dump_collapsed()` writes folded-stack
+captures (``thread;outer;inner <self_us>`` lines). Given a *before* and
+an *after* capture — two commits, two configs, two days of the same job
+— this tool normalizes each to its own total, folds to leaf frames, and
+prints the ops whose **share** of self time moved, worst regression
+first (the `flamegraph.diff_top` view). Absolute time is not compared:
+captures of different lengths are still honestly diffable by share.
+
+Usage::
+
+    python tools/flame_diff.py before.folded after.folded
+    python tools/flame_diff.py -k 40 --min-share 0.005 a.folded b.folded
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Diff two collapsed flamegraph captures by "
+                    "self-time share (regressions first).")
+    parser.add_argument("before", help="baseline collapsed capture")
+    parser.add_argument("after", help="candidate collapsed capture")
+    parser.add_argument("-k", type=int, default=20,
+                        help="rows to print (default 20)")
+    parser.add_argument("--min-share", type=float, default=0.001,
+                        help="noise floor: drop ops below this share in "
+                             "BOTH captures (default 0.001)")
+    args = parser.parse_args(argv)
+
+    from mxnet_tpu.telemetry import flamegraph
+
+    with open(args.before) as f:
+        before = f.read()
+    with open(args.after) as f:
+        after = f.read()
+    print(flamegraph.render_diff(before, after, k=args.k,
+                                 min_share=args.min_share))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
