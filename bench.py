@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -481,6 +482,188 @@ def _diagnostics_rows():
         shutil.rmtree(diag_dir, ignore_errors=True)
 
 
+def _healthplane_rows():
+    """Health-plane section (ISSUE 8): what operating the pod from
+    outside costs the step path. THE CONTRACT ROW:
+    push_export_step_overhead_pct <= 1 — a PushExporter snapshotting
+    the whole registry and handing it to the transport every 10 steps
+    (the gateway hop itself is network time off the critical path; an
+    in-memory transport isolates the render+buffer cost the LOOP pays).
+
+    Measurement discipline (the diagnostics-section rule): this box's
+    ms-scale step has a ±9% A/B noise floor — a 1% bound is resolved by
+    measuring the HOOK directly (hundreds of push() calls against the
+    live registry) and expressing the amortized per-step cost at the
+    every-10-steps cadence as a percentage of the median step; the
+    wall-clock A/B row stays as informative context. Informative:
+    health_endpoint_probe_ms — wall time of one GET /healthz against a
+    live MetricsServer with the HealthPlane mounted (an orchestrator's
+    liveness probe; served off-thread, so this is probe latency, not
+    step cost)."""
+    import urllib.request
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.telemetry import export
+    from mxnet_tpu.parallel import TrainStep, make_mesh
+
+    mx.random.seed(29)
+    rng = np.random.RandomState(29)
+    net = gluon.nn.HybridSequential(prefix="bench_hp_")
+    net.add(gluon.nn.Dense(1024, activation="relu", in_units=784,
+                           prefix="fc1_"))
+    net.add(gluon.nn.Dense(1024, activation="relu", in_units=1024,
+                           prefix="fc2_"))
+    net.add(gluon.nn.Dense(10, in_units=1024, prefix="fc3_"))
+    net.initialize(mx.init.Xavier())
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05},
+                     mesh=make_mesh())
+    x = rng.rand(256, 784).astype(np.float32)
+    y = rng.randint(0, 10, 256)
+    for _ in range(3):                      # compile + settle
+        float(np.asarray(step(x, y)))
+
+    iters = 50
+
+    def timed(per_step):
+        times = []
+        for i in range(iters):
+            t0 = time.perf_counter()
+            loss = step(x, y)
+            float(np.asarray(loss))
+            per_step(i)                     # cost under contract
+            times.append(time.perf_counter() - t0)
+        return times
+
+    def _mean(ts):
+        return sum(ts) / len(ts)
+
+    base = timed(lambda i: None)
+
+    sunk = []
+    exporter = export.PushExporter(
+        "http://bench.invalid:9091", interval_s=1e9,
+        transport=lambda url, body: sunk.append(len(body)))
+    pushed = timed(lambda i: exporter.push() if i % 10 == 0 else None)
+
+    base_mean_ms = _mean(base) * 1e3
+    base_med_ms = sorted(base)[len(base) // 2] * 1e3
+    push_mean_ms = _mean(pushed) * 1e3
+    _emit("healthplane_step_ms_base", round(base_mean_ms, 3), "ms")
+    _emit("healthplane_step_ms_push_exported",
+          round(push_mean_ms, 3), "ms")
+
+    # THE CONTRACT ROW: direct hook measurement — render + bounded
+    # buffer + in-memory transport per push, amortized over the
+    # every-10-steps cadence against the median step.
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        exporter.push()
+    push_ms = (time.perf_counter() - t0) / reps * 1e3
+    _emit("push_export_snapshot_ms", round(push_ms, 4), "ms")
+    _emit("push_export_step_overhead_pct",
+          round(push_ms / 10.0 / base_med_ms * 100.0, 3), "%")
+
+    # Probe latency against a real endpoint (informative).
+    plane = telemetry.healthplane.HealthPlane()
+    server = telemetry.start_http_server(0, health=plane)
+    try:
+        url = "http://%s:%d/healthz" % server.server_address
+        urllib.request.urlopen(url, timeout=10).read()   # warm
+        probes = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            urllib.request.urlopen(url, timeout=10).read()
+            probes.append(time.perf_counter() - t0)
+        _emit("health_endpoint_probe_ms",
+              round(sorted(probes)[len(probes) // 2] * 1e3, 3), "ms")
+    finally:
+        server.close()
+
+
+def _compile_accounting_rows():
+    """Compile-accounting rows (the ROADMAP direction-2 acceptance
+    baseline): per-site executable-cache-fill count and total seconds
+    accumulated by mx_compile_seconds{site} over THIS bench run. Two
+    runs' outputs diff with `bench.py --compare A.json B.json` — a
+    persistent compile cache is accepted when the second run's counts
+    drop to ~0."""
+    from mxnet_tpu.telemetry import memstats
+
+    for site, rec in sorted(memstats.compile_stats().items()):
+        _emit("compile_count[%s]" % site, rec["count"], "compiles")
+        _emit("compile_seconds[%s]" % site, round(rec["total_s"], 3),
+              "s")
+
+
+def _load_rows(path):
+    """Parse one bench output (JSON row per line; non-JSON lines — e.g.
+    stderr interleave — are skipped) into {metric: row}."""
+    rows = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                rows[rec["metric"]] = rec
+    return rows
+
+
+def compare(a_path, b_path):
+    """`bench.py --compare A.json B.json`: emit per-site compile
+    count/seconds DELTAS (B - A) from the two runs' compile-accounting
+    rows. This is the acceptance measurement for recompile-elimination
+    work: a persistent compile cache must drive every
+    compile_count_delta row to -count (second run compiles nothing).
+    Returns 0 when both files had accounting rows."""
+    import re as _re
+
+    a, b = _load_rows(a_path), _load_rows(b_path)
+    row_re = _re.compile(r"^compile_(count|seconds)\[(.+)\]$")
+    sites = {}
+    for metric in list(a) + list(b):
+        m = row_re.match(metric)
+        if m:
+            sites.setdefault(m.group(2), set()).add(m.group(1))
+    if not sites:
+        print(json.dumps({"metric": "compile_compare_error", "value": 0,
+                          "unit": "",
+                          "detail": "no compile_count[site]/"
+                                    "compile_seconds[site] rows in "
+                                    "either input"}), flush=True)
+        return 1
+    total_count = total_s = 0.0
+    for site in sorted(sites):
+        for kind, unit in (("count", "compiles"), ("seconds", "s")):
+            metric = "compile_%s[%s]" % (kind, site)
+            va = float(a.get(metric, {}).get("value", 0) or 0)
+            vb = float(b.get(metric, {}).get("value", 0) or 0)
+            delta = vb - va
+            if kind == "count":
+                total_count += delta
+            else:
+                total_s += delta
+            print(json.dumps({
+                "metric": "compile_%s_delta[%s]" % (kind, site),
+                "value": round(delta, 3), "unit": unit,
+                "a": va, "b": vb}), flush=True)
+    print(json.dumps({"metric": "compile_count_delta_total",
+                      "value": round(total_count, 3),
+                      "unit": "compiles"}), flush=True)
+    print(json.dumps({"metric": "compile_seconds_delta_total",
+                      "value": round(total_s, 3), "unit": "s"}),
+          flush=True)
+    return 0
+
+
 def _data_pipeline_rows():
     """Data pipeline section (mxnet_tpu.data, ISSUE 6): per-batch decode
     cost, prefetch overlap, and the step-path input-stall fraction
@@ -828,8 +1011,21 @@ def _acquire_device(timeout_s=120):
 
 
 def main():
+    import argparse
     import sys
     import traceback
+
+    parser = argparse.ArgumentParser(
+        description="mxnet_tpu benchmark (JSON row per line); "
+                    "--compare diffs two runs' compile accounting.")
+    parser.add_argument("--compare", nargs=2,
+                        metavar=("A.json", "B.json"),
+                        help="emit per-site compile count/seconds "
+                             "deltas (B - A) from two bench outputs "
+                             "and exit (no device needed)")
+    args = parser.parse_args()
+    if args.compare:
+        return compare(args.compare[0], args.compare[1])
 
     dev = _acquire_device()
     # Non-headline rows never take down the headline: a failed variant
@@ -876,6 +1072,11 @@ def main():
         print("bench diagnostics section failed:", file=sys.stderr)
         traceback.print_exc()
     try:
+        _healthplane_rows()
+    except Exception:
+        print("bench healthplane section failed:", file=sys.stderr)
+        traceback.print_exc()
+    try:
         _data_pipeline_rows()
     except Exception:
         print("bench data_pipeline section failed:", file=sys.stderr)
@@ -890,12 +1091,20 @@ def main():
     except Exception:
         print("bench checkpoint section failed:", file=sys.stderr)
         traceback.print_exc()
-    # Headline LAST (driver parses the final JSON line; BENCH_r01/r02
-    # continuity).
+    # Measure the headline BEFORE the compile accounting so its fresh
+    # TrainStep compile (the largest single compile of the run) is in
+    # the accounting; its row still prints LAST (driver parses the
+    # final JSON line; BENCH_r01/r02 continuity).
     train32 = _train_rate(32, None, dev)
+    try:
+        # After every section: the accounting covers the whole run.
+        _compile_accounting_rows()
+    except Exception:
+        print("bench compile accounting failed:", file=sys.stderr)
+        traceback.print_exc()
     _row("resnet50_v1_train_img_per_sec_b32", train32, 298.51,
          TRAIN_GFLOP_PER_IMG)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

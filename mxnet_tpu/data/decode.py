@@ -20,6 +20,13 @@ Two delivery modes:
 Worker exceptions are captured and re-raised at the consumption point
 (the ``PrefetchingIter.prefetch_func`` lesson: a decode error must
 surface in the consumer, never strand it waiting forever).
+
+Each worker thread heartbeats its own ``data``-base watchdog lane
+around every ``fn(item)`` call, so a decode wedged on dead storage (or
+a poisoned augmenter loop) fires a ``data_hang`` anomaly — with that
+worker's stack in the flight-recorder bundle — instead of surfacing
+only as the consumer's ever-growing ``data::wait`` span. Lanes are
+claimed lazily (first item per worker) and released on ``close()``.
 """
 from __future__ import annotations
 
@@ -27,6 +34,8 @@ import collections
 import queue as _queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
+
+from ..telemetry import watchdog as _watchdog
 
 __all__ = ["DecodePool"]
 
@@ -48,6 +57,24 @@ class DecodePool:
                                         thread_name_prefix="mx_data_decode")
         self._closed = False
         self._lock = threading.Lock()
+        self._lanes = []            # watchdog lanes claimed by workers
+        self._tls = threading.local()
+
+    def _decode(self, item):
+        """Worker body: ``fn(item)`` heartbeating this worker's
+        watchdog lane (claimed on its first item, ``data``/``data#N``)
+        — in-flight decode past the deadline fires ``data_hang``."""
+        lane = getattr(self._tls, "lane", None)
+        if lane is None:
+            lane = _watchdog.unique_lane("data")
+            self._tls.lane = lane
+            with self._lock:
+                self._lanes.append(lane)
+        _watchdog.begin(lane)
+        try:
+            return self.fn(item)
+        finally:
+            _watchdog.end(lane)
 
     def run(self, items):
         """Generator: ``fn(item)`` for each item of the (possibly
@@ -63,7 +90,8 @@ class DecodePool:
             while True:
                 while len(window) < self.inflight and not self._closed:
                     try:
-                        window.append(self._pool.submit(self.fn, next(it)))
+                        window.append(self._pool.submit(self._decode,
+                                                        next(it)))
                     except StopIteration:
                         break
                 if not window:
@@ -80,7 +108,7 @@ class DecodePool:
 
         def work(item):
             try:
-                done.put((True, self.fn(item)))
+                done.put((True, self._decode(item)))
             except BaseException as exc:   # noqa: BLE001 — relayed below
                 done.put((False, exc))
 
@@ -100,12 +128,18 @@ class DecodePool:
             yield payload
 
     def close(self):
-        """Shut the worker team down (idempotent)."""
+        """Shut the worker team down (idempotent) and release the
+        workers' watchdog lanes — a long-lived process cycling pipelines
+        must not accumulate dead ``data#N`` lanes."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
         self._pool.shutdown(wait=True)
+        with self._lock:
+            lanes, self._lanes = self._lanes, []
+        for lane in lanes:      # workers joined: no begin() can revive
+            _watchdog.reset(lane)
 
     def __enter__(self):
         return self

@@ -42,6 +42,7 @@ import numpy as np
 
 from .. import io as mxio
 from ..ndarray.ndarray import NDArray, array as _nd_array
+from ..telemetry import healthplane as _hp
 from ..telemetry import metrics as _tm
 from ..telemetry import trace as _trace
 from .decode import DecodePool
@@ -167,6 +168,11 @@ class DataPipeline:
         # read by debug_state() for flight-recorder bundles.
         self._last_batch = None
         self._closed = False
+        # Readiness slot for /readyz: claimed when the stages spin up,
+        # flipped ready once the first batch reaches the training loop
+        # ("pipeline primed"), released on close().
+        self._hp_component = None
+        self._hp_ready = False
 
     # -- geometry -------------------------------------------------------------
 
@@ -286,6 +292,10 @@ class DataPipeline:
             raise RuntimeError("DataPipeline is closed")
         if self._batches is not None:
             return
+        if self._hp_component is None:
+            self._hp_component = _hp.unique_component("data_pipeline")
+        self._hp_ready = False
+        _hp.set_ready(self._hp_component, False)
         epoch, cursor = self._ckpt_view
         batches = self._assemble(self._samples(epoch, cursor),
                                  epoch, cursor)
@@ -350,6 +360,9 @@ class DataPipeline:
                            if end >= self.samples_per_epoch
                            else (batch["epoch"], end))
         _samples_total.inc(self.batch_size)
+        if not self._hp_ready:      # first delivered batch: primed
+            self._hp_ready = True
+            _hp.set_ready(self._hp_component)
         return out
 
     next = __next__
@@ -364,6 +377,9 @@ class DataPipeline:
         """Shut down worker stages (idempotent; context manager)."""
         self._teardown()
         self._closed = True
+        if self._hp_component is not None:
+            _hp.clear_ready(self._hp_component)
+            self._hp_component = None
 
     def __enter__(self):
         return self

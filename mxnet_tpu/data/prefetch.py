@@ -25,6 +25,7 @@ import time
 
 from ..telemetry import metrics as _tm
 from ..telemetry import trace as _trace
+from ..telemetry import watchdog as _watchdog
 
 __all__ = ["DevicePrefetcher", "data_wait_seconds"]
 
@@ -61,6 +62,12 @@ class DevicePrefetcher:
         self._place = place
         self._q = _queue.Queue(maxsize=int(depth))
         self._stop = threading.Event()
+        # Watchdog lane for the production side: a source pull (or
+        # device_put) that wedges fires `data_hang` with this thread's
+        # stack in the bundle. Blocking on a FULL queue is deliberately
+        # OUTSIDE the heartbeat — a slow consumer is backpressure, not
+        # a hang.
+        self._wd_lane = _watchdog.unique_lane("data")
         self._thread = threading.Thread(target=self._produce,
                                         name="mx_data_prefetch",
                                         daemon=True)
@@ -68,17 +75,21 @@ class DevicePrefetcher:
 
     def _produce(self):
         while not self._stop.is_set():
+            _watchdog.begin(self._wd_lane)
             try:
                 batch = next(self._source)
                 if self._place is not None:
                     with _trace.span("data::put"):
                         batch = self._place(batch)
             except StopIteration:
+                _watchdog.end(self._wd_lane)
                 self._offer(_Stop())
                 return
             except BaseException as exc:   # noqa: BLE001 — relayed to consumer
+                _watchdog.end(self._wd_lane)
                 self._offer(_Raise(exc))
                 return
+            _watchdog.end(self._wd_lane)
             if not self._offer(batch):
                 return
 
@@ -114,7 +125,10 @@ class DevicePrefetcher:
     next = __next__
 
     def close(self, timeout=5.0):
-        """Stop the producer and join it (idempotent)."""
+        """Stop the producer and join it (idempotent); releases the
+        watchdog lane once the thread is really gone (a thread still
+        wedged past the join timeout keeps its lane — that hang should
+        stay visible)."""
         self._stop.set()
         try:
             while True:                   # unblock a full-queue producer
@@ -122,6 +136,8 @@ class DevicePrefetcher:
         except _queue.Empty:
             pass
         self._thread.join(timeout=timeout)
+        if not self._thread.is_alive():
+            _watchdog.reset(self._wd_lane)
         try:                              # a batch the producer slipped
             while True:                   # in during the join would sit
                 self._q.get_nowait()      # ahead of the sentinel

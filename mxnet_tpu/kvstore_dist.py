@@ -462,6 +462,35 @@ class KVStoreDist(KVStoreLocal):
         blob)}`` with ages measured on the server's clock."""
         return self._call(0, ("telemetry_pull",))
 
+    # -- pod forensics channel (telemetry.healthplane rides this) -------------
+    # Flight-recorder bundles and pod-snapshot requests cross the same
+    # worker->server wire: bundles are pushed fire-and-forget (they are
+    # tens of KB and already committed locally — losing one to a dying
+    # server loses nothing a local disk doesn't still hold), pulls and
+    # request operations are blocking RPCs.
+
+    def diag_push(self, name, blob):
+        """Publish one committed diagnostic bundle (file name + bytes)
+        for rank 0 to collect (pipelined ack, push fast path)."""
+        self._post(0, ("diag_push", self._rank, name, blob))
+
+    def diag_pull(self):
+        """Drain every rank's pushed bundles:
+        ``{rank: [(name, blob), ...]}`` — each bundle hands off exactly
+        once (rank 0's collector commits them to its directory)."""
+        return self._call(0, ("diag_pull",))
+
+    def diag_request(self, kind, msg=""):
+        """Post a pod-snapshot request (rank 0's fan-out trigger);
+        returns the new request sequence number every rank's collector
+        will observe."""
+        return self._call(0, ("diag_request", kind, msg))
+
+    def diag_request_check(self):
+        """Read the current pod-snapshot request slot:
+        ``(seq, kind, msg)`` (seq 0 = never requested)."""
+        return self._call(0, ("diag_request_check",))
+
     def set_gradient_compression(self, compression_params):
         from .gradient_compression import GradientCompression
 

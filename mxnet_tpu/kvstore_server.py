@@ -298,6 +298,14 @@ class KVStoreServer:
         self._keys = {}
         self._conn_rank = {}        # conn id -> worker rank (from hello)
         self._telemetry = {}        # worker rank -> (recv_time, blob)
+        # Diag-bundle rendezvous (telemetry.healthplane.DiagCollector):
+        # per-rank pushed bundles awaiting rank 0's pull, bounded so a
+        # dead collector cannot make the server hoard bundles; plus the
+        # pod-snapshot request slot workers poll.
+        self._diag = {}             # worker rank -> [(name, blob), ...]
+        self._diag_bound = int(os.environ.get(
+            "MXNET_PS_DIAG_BUFFER", "16"))
+        self._diag_request = (0, None, None)    # (seq, kind, msg)
         self._updater = None
         self._opt_blob = None       # pickled optimizer for snapshots
         self._sync_mode = True
@@ -531,6 +539,33 @@ class KVStoreServer:
             self._send(conn, ("val", {rank: (now - t, blob)
                                       for rank, (t, blob)
                                       in self._telemetry.items()}))
+        elif cmd == "diag_push":
+            # Pod forensics rendezvous (telemetry.healthplane): a rank
+            # publishes one committed flight-recorder bundle — (rank,
+            # name, blob) — for rank 0 to pull. Server 0 by convention,
+            # same as telemetry_push; pipelined ack.
+            q = self._diag.setdefault(msg[1], [])
+            q.append((msg[2], msg[3]))
+            # bound <= 0 keeps nothing (del q[:-0] would keep EVERYTHING
+            # — an unbounded hoard, the opposite of the bound's intent).
+            q[:] = q[-self._diag_bound:] if self._diag_bound > 0 else []
+            self._send(conn, ("ok",))
+        elif cmd == "diag_pull":
+            # Drain semantics: bundles hand off exactly once — repeated
+            # collects are incremental and the buffer never regrows
+            # past one round's worth.
+            pending, self._diag = self._diag, {}
+            self._send(conn, ("val", pending))
+        elif cmd == "diag_request":
+            # Pod-snapshot fan-out: rank 0 bumps the request slot; every
+            # rank's DiagCollector polls diag_request_check and captures
+            # a bundle when the sequence advances.
+            seq = self._diag_request[0] + 1
+            self._diag_request = (seq, msg[1],
+                                  msg[2] if len(msg) > 2 else "")
+            self._send(conn, ("val", seq))
+        elif cmd == "diag_request_check":
+            self._send(conn, ("val", self._diag_request))
         elif cmd == "profiler":
             # Remote server profiling (reference
             # KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49,

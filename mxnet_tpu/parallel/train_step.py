@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random
+from ..telemetry import healthplane as _hp
 from ..telemetry import memstats as _ms
 from ..telemetry import metrics as _tm
 from ..telemetry import trace as _trace
@@ -127,6 +128,15 @@ class TrainStep:
         self._materialized = False
         self._multiproc = False
         self._compile_pending = False
+        # Readiness slot for /readyz: claimed lazily on the FIRST
+        # __call__ (a TrainStep built but never stepped — eval-only, a
+        # discarded retune — must not leave a permanently not-ready
+        # ghost; there is no close() to release one), flipped ready
+        # once the warmup compile lands, so an orchestrator's readiness
+        # gate holds traffic/elastic peers off a rank still paying
+        # whole-step XLA compile.
+        self._hp_component = None
+        self._hp_ready = False
 
     def _make_opt_rule(self):
         """(n_states, update_fn) for the configured optimizer.
@@ -587,6 +597,8 @@ class TrainStep:
         feeds its own `num_parts`/`part_index` shard of the epoch.
         """
         t_start = time.perf_counter()
+        if self._hp_component is None:
+            self._hp_component = _hp.unique_component("train_step")
         # Heartbeat lane for the hang watchdog: in-flight work between
         # begin/end past its deadline fires a `step_hang` anomaly with
         # this thread's stack in the bundle.
@@ -641,6 +653,9 @@ class TrainStep:
                 # compile — the compile-accounting seam.
                 self._compile_pending = False
                 _ms.observe_compile("train_step", t_end - t_start)
+            if not self._hp_ready:  # warmup compile done: ready
+                self._hp_ready = True
+                _hp.set_ready(self._hp_component)
             if self._multiproc:
                 # The replicated loss is not fully addressable from one
                 # controller; hand back this process's local replica so

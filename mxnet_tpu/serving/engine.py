@@ -26,6 +26,7 @@ import numpy as np
 from .. import ndarray as nd
 from ..cached_op import CachedOp
 from ..ndarray.ndarray import NDArray
+from ..telemetry import healthplane as _hp
 from ..telemetry import trace as _trace
 from ..telemetry import watchdog as _watchdog
 from .admission import AdmissionController
@@ -132,6 +133,12 @@ class InferenceServer:
         # Per-server watchdog lane: a lane is a single slot, so two
         # servers sharing "serving" would mask each other's hangs.
         self._wd_lane = _watchdog.unique_lane("serving")
+        # Readiness slot for /readyz: not ready until the bucket ladder
+        # is warm (every pre-warmup request would pay compile latency —
+        # a load balancer must not route here yet). A server run with
+        # warmup=False turns ready on its first completed batch instead.
+        self._hp_component = _hp.unique_component("serving")
+        self._hp_ready = False
         # Serializes device calls: warmup() on an already-started server
         # must not race the worker through the model's executor cache.
         self._model_lock = threading.Lock()
@@ -176,6 +183,9 @@ class InferenceServer:
                 for o in (out if isinstance(out, tuple) else (out,)):
                     o.wait_to_read()
                 self._warmed.add(b)
+        if not self._hp_ready:
+            self._hp_ready = True
+            _hp.set_ready(self._hp_component)
         return self
 
     def start(self):
@@ -193,9 +203,11 @@ class InferenceServer:
 
     def shutdown(self, drain=True, timeout=None):
         self._batcher.shutdown(drain=drain, timeout=timeout)
-        # Release this server's watchdog lane so long-lived processes
-        # cycling servers don't accumulate dead lanes.
+        # Release this server's watchdog lane and readiness slot so
+        # long-lived processes cycling servers don't accumulate dead
+        # lanes or permanently not-ready ghosts.
         _watchdog.reset(self._wd_lane)
+        _hp.clear_ready(self._hp_component)
 
     def __enter__(self):
         return self
@@ -247,6 +259,9 @@ class InferenceServer:
         _watchdog.begin(self._wd_lane)
         try:
             self._run_batch_inner(requests, bucket)
+            if not self._hp_ready:  # warmup=False server: first batch
+                self._hp_ready = True
+                _hp.set_ready(self._hp_component)
         finally:
             _watchdog.end(self._wd_lane)
 

@@ -76,6 +76,22 @@ Failure forensics (ISSUE 7) turns detection into evidence:
 * :mod:`.memstats` — ``mx_device_live_bytes``/``_buffers``/peak gauges
   sampled from the backend, and ``mx_compile_seconds{site}`` fed by the
   CachedOp / fused-apply / TrainStep executable-cache-fill seams.
+
+The fleet health plane (ISSUE 8) makes the pod operable from outside:
+
+* :mod:`.healthplane` — ``GET /healthz``/``/readyz`` liveness and
+  readiness probes plus ``/debug/*`` JSON views mounted on the same
+  ``/metrics`` server (``start_http_server(..., health=HealthPlane())``),
+  a process-wide component readiness registry the TrainStep / serving /
+  data-pipeline warmup paths feed, and :class:`DiagCollector` — flight-
+  recorder bundles shipped to rank 0 over the kvstore ``diag_push``
+  channel plus the ``request_bundle`` pod-snapshot fan-out.
+* :class:`.export.PushExporter` — periodic push-gateway export of any
+  registry (rank 0 passes its Aggregator so one push describes the
+  pod), bounded retry buffer + exponential backoff.
+* Fleet SLOs — ``Aggregator.fleet_slo(...)`` scopes a
+  :class:`.slo.ServiceLevelObjective` to the merged ``rank="all"``
+  histograms so ONE rank-0 ``BurnRateMonitor`` alerts for the pod.
 """
 from __future__ import annotations
 
@@ -89,27 +105,30 @@ from . import memstats
 from . import watchdog
 from . import recorder
 from . import numerics
+from . import healthplane
 from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
                       render_prometheus, start_http_server,
                       default_buckets, set_exemplars)
 from .health import StepMonitor
 from .aggregate import Aggregator, LocalBus
-from .export import StreamingTraceWriter
+from .export import StreamingTraceWriter, PushExporter
 from .slo import BurnRateMonitor, ServiceLevelObjective
 from .recorder import FlightRecorder
 from .watchdog import HangWatchdog
 from .numerics import NumericGuard, NonFiniteError
 from .memstats import DeviceMemoryMonitor
+from .healthplane import HealthPlane, DiagCollector
 
 __all__ = ["metrics", "trace", "aggregate", "export", "flamegraph",
            "slo", "memstats", "watchdog", "recorder", "numerics",
-           "Registry", "REGISTRY", "counter", "gauge",
+           "healthplane", "Registry", "REGISTRY", "counter", "gauge",
            "histogram", "render_prometheus", "start_http_server",
            "default_buckets", "set_exemplars", "StepMonitor",
            "Aggregator", "LocalBus", "StreamingTraceWriter",
-           "BurnRateMonitor", "ServiceLevelObjective", "FlightRecorder",
-           "HangWatchdog", "NumericGuard", "NonFiniteError",
-           "DeviceMemoryMonitor", "set_enabled", "enabled"]
+           "PushExporter", "BurnRateMonitor", "ServiceLevelObjective",
+           "FlightRecorder", "HangWatchdog", "NumericGuard",
+           "NonFiniteError", "DeviceMemoryMonitor", "HealthPlane",
+           "DiagCollector", "set_enabled", "enabled"]
 
 
 def set_enabled(on):

@@ -205,11 +205,17 @@ class LocalBus:
     ``endpoint(rank)`` returns an object with the same four-member
     transport surface ``KVStoreDist`` exposes."""
 
+    # Bounded per-rank diag-bundle buffer, matching the kvstore server's
+    # own bound so LocalBus tests exercise the same drop behavior.
+    MAX_DIAG_PER_RANK = 16
+
     def __init__(self, num_workers=1, clock=time.monotonic):
         self.num_workers = int(num_workers)
         self._clock = clock
         self._lock = threading.Lock()
         self._store = {}            # rank -> (received_at, blob)
+        self._diag = {}             # rank -> [(name, blob), ...]
+        self._diag_request = (0, None, None)    # (seq, kind, msg)
 
     def push(self, rank, blob):
         with self._lock:
@@ -220,6 +226,30 @@ class LocalBus:
         with self._lock:
             return {rank: (now - t, blob)
                     for rank, (t, blob) in self._store.items()}
+
+    # -- diag channel (healthplane.DiagCollector rides this) ------------------
+
+    def diag_push(self, rank, name, blob):
+        with self._lock:
+            q = self._diag.setdefault(int(rank), [])
+            q.append((name, blob))
+            bound = self.MAX_DIAG_PER_RANK
+            q[:] = q[-bound:] if bound > 0 else []
+
+    def diag_pull(self):
+        with self._lock:
+            out, self._diag = self._diag, {}
+        return out
+
+    def diag_request(self, kind, msg=""):
+        with self._lock:
+            seq = self._diag_request[0] + 1
+            self._diag_request = (seq, kind, msg)
+        return seq
+
+    def diag_request_check(self):
+        with self._lock:
+            return self._diag_request
 
     def endpoint(self, rank):
         return _LocalEndpoint(self, int(rank))
@@ -236,6 +266,18 @@ class _LocalEndpoint:
 
     def telemetry_pull(self):
         return self._bus.pull()
+
+    def diag_push(self, name, blob):
+        self._bus.diag_push(self.rank, name, blob)
+
+    def diag_pull(self):
+        return self._bus.diag_pull()
+
+    def diag_request(self, kind, msg=""):
+        return self._bus.diag_request(kind, msg)
+
+    def diag_request_check(self):
+        return self._bus.diag_request_check()
 
 
 # -- the aggregator -----------------------------------------------------------
@@ -356,6 +398,37 @@ class Aggregator:
         first round or on other ranks)."""
         with self._lock:
             return self._fleet
+
+    def get(self, name):
+        """Registry-duck resolution against the LAST MERGED fleet view
+        (None before the first round or on non-zero ranks) — what lets a
+        ``ServiceLevelObjective(..., registry=aggregator)`` evaluate
+        against the live fleet even though every merge builds a fresh
+        Registry object."""
+        fleet = self.fleet
+        return None if fleet is None else fleet.get(name)
+
+    def fleet_slo(self, name, objective, threshold_s, family,
+                  labels=None):
+        """Declare a FLEET-level latency SLO: evaluated on this
+        aggregator's merged registry, scoped to the ``rank="all"``
+        ``sum without (rank)`` series the merge adds per histogram
+        family — so burn rates describe the pod's combined traffic, not
+        one rank's. Register the result with a ``BurnRateMonitor``
+        running on rank 0 (whose gauges/alert counters land in the
+        LOCAL registry as usual)::
+
+            burn = telemetry.BurnRateMonitor(monitor=monitor)
+            burn.add(agg.fleet_slo("pod_latency", 0.99, 0.25,
+                                   "mx_serving_request_latency_seconds"))
+        """
+        from .slo import ServiceLevelObjective
+
+        labels = dict(labels or {})
+        labels.setdefault("rank", "all")
+        return ServiceLevelObjective(name, objective, threshold_s,
+                                     family, labels=labels,
+                                     registry=self)
 
     def merged_quantile(self, name, q, **labels):
         """Fleet-wide quantile of a histogram family from its

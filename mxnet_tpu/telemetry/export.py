@@ -36,11 +36,14 @@ import re
 import threading
 import time
 
+from collections import deque
+
+from . import metrics as _metrics
 from . import trace as _trace
 from .. import log as _log
 
-__all__ = ["StreamingTraceWriter", "commit_bytes", "default_rank",
-           "SEGMENT_FORMAT", "segment_name", "SEGMENT_RE"]
+__all__ = ["StreamingTraceWriter", "PushExporter", "commit_bytes",
+           "default_rank", "SEGMENT_FORMAT", "segment_name", "SEGMENT_RE"]
 
 SEGMENT_FORMAT = "mxnet_tpu.trace_segment/1"
 SEGMENT_RE = re.compile(r"^trace\.rank(\d+)\.(\d+)\.jsonl$")
@@ -244,6 +247,250 @@ class StreamingTraceWriter:
             pass
         with self._lock:
             self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# -- remote metric export ------------------------------------------------------
+
+_push_total = _metrics.REGISTRY.counter(
+    "mx_export_pushes_total",
+    "Registry snapshots delivered to the remote push gateway")
+_push_failures = _metrics.REGISTRY.counter(
+    "mx_export_failures_total",
+    "Failed push-gateway deliveries (buffered for retry with backoff)")
+_push_dropped = _metrics.REGISTRY.counter(
+    "mx_export_dropped_total",
+    "Rendered snapshots dropped because the retry buffer was full")
+_push_buffered = _metrics.REGISTRY.gauge(
+    "mx_export_buffered",
+    "Rendered snapshots awaiting (re)delivery to the push gateway")
+
+
+def _http_post(url, body):
+    """Default PushExporter transport: one stdlib POST of the classic
+    Prometheus text exposition (the push-gateway wire format). Raises
+    on any network error or HTTP >= 400."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type":
+                 "text/plain; version=0.0.4; charset=utf-8"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        status = getattr(resp, "status", 200)
+        if status >= 400:       # some transports don't raise on 4xx/5xx
+            raise OSError("push gateway returned HTTP %d" % status)
+
+
+class PushExporter:
+    """Periodically push a registry's Prometheus exposition to a
+    push-gateway URL — the egress half of the health plane, for fleets
+    whose monitoring cannot scrape into the pod (batch jobs behind NAT,
+    the classic Pushgateway deployment).
+
+    Parameters
+    ----------
+    url : push-gateway base, e.g. ``http://gateway:9091``. The snapshot
+        is POSTed to ``<url>/metrics/job/<job>[/instance/<instance>]``
+        (pass a full path containing ``/metrics/`` to override).
+    registry : what to render — a ``Registry`` or an ``Aggregator``
+        (rank 0 passes its aggregator so ONE push describes the whole
+        pod). Default: the process-wide registry.
+    job, instance : push-gateway grouping labels in the URL path.
+    interval_s : snapshot cadence for ``tick()``/``start()``.
+    max_buffer : bounded retry buffer of rendered snapshots. While the
+        gateway is down, snapshots queue here oldest-first;
+        overflow drops the OLDEST (the gateway keeps last-write-wins
+        state, so the freshest snapshot is the one that matters) and
+        counts ``mx_export_dropped_total``.
+    backoff_s / max_backoff_s : exponential retry backoff after a
+        failed delivery (1 s doubling to 5 min by default); any
+        successful delivery resets it.
+    transport : injectable ``fn(url, body_bytes)`` raising on failure —
+        tests inject gateway 500s/timeouts without sockets. Default:
+        stdlib POST.
+    clock : injectable monotonic clock.
+
+    ``tick()`` never raises: a failed delivery counts
+    ``mx_export_failures_total``, arms the backoff and leaves the
+    snapshot buffered; the step loop is never the casualty of a dead
+    gateway.
+    """
+
+    def __init__(self, url, registry=None, job="mxnet_tpu", instance=None,
+                 interval_s=15.0, max_buffer=8, backoff_s=1.0,
+                 max_backoff_s=300.0, transport=None, clock=time.monotonic):
+        self.url = self._target(url, job, instance)
+        self._registry = registry
+        self.interval_s = float(interval_s)
+        self.max_buffer = int(max_buffer)
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self._transport = transport if transport is not None else _http_post
+        self._clock = clock
+        self._lock = threading.Lock()       # buffer/backoff state only
+        self._send_lock = threading.Lock()  # serializes deliveries
+        self._buffer = deque()      # rendered snapshots, oldest first
+        self._last = None           # clock() of last rendered snapshot
+        self._backoff = None        # current backoff, None = healthy
+        self._retry_at = None       # clock() gate for the next attempt
+        self._stop = threading.Event()
+        self._thread = None
+
+    @staticmethod
+    def _target(url, job, instance):
+        if "/metrics/" in url:
+            return url
+        path = "/metrics/job/%s" % job
+        if instance is not None:
+            path += "/instance/%s" % instance
+        return url.rstrip("/") + path
+
+    def _render(self):
+        from . import metrics as _m
+
+        reg = self._registry or _m.REGISTRY
+        return reg.render_prometheus().encode("utf-8")
+
+    @property
+    def pending(self):
+        with self._lock:
+            return len(self._buffer)
+
+    # -- delivery -------------------------------------------------------------
+
+    def _enqueue_locked(self, body):
+        if len(self._buffer) >= self.max_buffer:
+            self._buffer.popleft()
+            _push_dropped.inc()
+        self._buffer.append(body)
+        _push_buffered.set(len(self._buffer))
+
+    def _flush(self, now, blocking):
+        """Deliver buffered snapshots oldest-first with the network call
+        made OUTSIDE the state lock — a slow or blackholing gateway must
+        never stall ``pending``/``tick()`` callers on another thread. A
+        failure arms the exponential backoff and keeps the remainder for
+        the next attempt. Returns None without delivering when another
+        thread is already mid-delivery and ``blocking`` is False."""
+        if not self._send_lock.acquire(blocking=blocking):
+            return None
+        try:
+            while True:
+                with self._lock:
+                    if not self._buffer:
+                        self._backoff = None
+                        self._retry_at = None
+                        return True
+                    head = self._buffer[0]
+                try:
+                    self._transport(self.url, head)
+                except Exception as exc:
+                    with self._lock:
+                        _push_failures.inc()
+                        self._backoff = self.backoff_s \
+                            if self._backoff is None \
+                            else min(2.0 * self._backoff,
+                                     self.max_backoff_s)
+                        self._retry_at = now + self._backoff
+                        buffered = len(self._buffer)
+                        backoff = self._backoff
+                    _log.warn_rate_limited(
+                        _log.get_logger("mxnet_tpu.telemetry"),
+                        "push_export:%d" % id(self), 30.0,
+                        "push-gateway delivery failed (%d buffered, "
+                        "retry in %.1fs): %s", buffered, backoff, exc)
+                    return False
+                with self._lock:
+                    # The bounded enqueue may have dropped this head
+                    # while the POST was in flight — only pop it if it
+                    # is still the head.
+                    if self._buffer and self._buffer[0] is head:
+                        self._buffer.popleft()
+                    _push_total.inc()
+                    _push_buffered.set(len(self._buffer))
+                    # ANY successful delivery resets the backoff (the
+                    # documented contract): a flapping gateway that
+                    # accepts every other POST must not climb toward
+                    # max_backoff_s and stretch the push cadence.
+                    self._backoff = None
+                    self._retry_at = None
+        finally:
+            self._send_lock.release()
+
+    def push(self):
+        """Render one snapshot NOW and attempt delivery (plus any
+        backlog). Returns True when the buffer fully drained."""
+        body = self._render()
+        with self._lock:
+            self._last = self._clock()
+            self._enqueue_locked(body)
+        return self._flush(self._clock(), blocking=True)
+
+    def tick(self):
+        """Step-loop cadence call: render once per ``interval_s``;
+        retry buffered snapshots once the backoff window passes. Never
+        raises, and never queues behind a delivery already in flight on
+        another thread (it returns None and leaves the snapshot
+        buffered for that delivery to drain)."""
+        now = self._clock()
+        body = None
+        with self._lock:
+            due = self._last is None or now - self._last >= self.interval_s
+            if due:
+                self._last = now
+        if due:
+            try:
+                body = self._render()
+            except Exception as exc:        # a broken duck registry
+                _log.warn_rate_limited(
+                    _log.get_logger("mxnet_tpu.telemetry"),
+                    "push_export:render:%d" % id(self), 30.0,
+                    "push-export render failed (will retry): %s", exc)
+        with self._lock:
+            if body is not None:
+                self._enqueue_locked(body)
+            if not self._buffer or \
+                    (self._retry_at is not None and now < self._retry_at):
+                return None
+        return self._flush(now, blocking=False)
+
+    # -- background mode ------------------------------------------------------
+
+    def start(self):
+        """Push every ``interval_s`` from a daemon thread (returns
+        self)."""
+        if self._thread is None:
+            self._stop.clear()
+
+            def loop():
+                while not self._stop.wait(
+                        min(self.interval_s, self._backoff or
+                            self.interval_s)):
+                    self.tick()
+
+            self._thread = threading.Thread(
+                target=loop, name="mx-telemetry-push", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self, timeout=5.0):
+        """Stop the thread and attempt one final delivery so the
+        gateway holds this process's last state."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        try:
+            self.push()
+        except Exception:
+            pass
 
     def __enter__(self):
         return self

@@ -1,0 +1,430 @@
+"""mxnet_tpu.telemetry.healthplane — the fleet health plane: live
+health/readiness/debug endpoints and pod-wide forensics collection.
+
+PRs 3/5/7 gave every rank metrics, spans, anomaly detection and flight-
+recorder bundles — but all of it is *introspection*: nothing lets an
+orchestrator (or a human with curl) operate the pod from outside. This
+module closes that loop with three pieces:
+
+* **Readiness registry** (module level, the watchdog-lane discipline).
+  Long-lived components claim a slot (:func:`unique_component`) and
+  flip it with :func:`set_ready`: ``TrainStep`` after its warmup
+  compile lands, an ``InferenceServer`` once its bucket ladder is warm,
+  a ``DataPipeline`` once its first batch is delivered. ``/readyz``
+  answers 200 only when every registered component is ready — the
+  Borg/Kubernetes readiness-gate shape, so a load balancer never routes
+  to a rank that is still compiling.
+
+* **:class:`HealthPlane`** — the request handler behind the new
+  endpoints ``start_http_server(..., health=plane)`` mounts next to
+  ``/metrics`` on the SAME :class:`~.metrics.MetricsServer`:
+
+  ===========================  =============================================
+  ``GET /healthz``             liveness: 200 unless a watchdog lane has
+                               in-flight work past its deadline (a hung
+                               step/serving batch/decode pool = not alive)
+  ``GET /readyz``              readiness: 200 when every registered
+                               component reports ready
+  ``GET /debug/stacks``        every thread's current stack (JSON)
+  ``GET /debug/watchdog``      lane states + effective deadlines
+  ``GET /debug/pipeline``      watched DataPipelines' ``debug_state()``
+  ``GET /debug/memory``        device memory + compile accounting
+  ``POST /debug/bundle``       trigger a local flight-recorder bundle NOW
+  ===========================  =============================================
+
+  Everything is a JSON view over state the forensics layer already
+  maintains — the endpoints add no new bookkeeping to any hot path.
+
+* **:class:`DiagCollector`** — pod-wide forensics over the kvstore
+  command channel (the ``telemetry_push`` precedent): each rank's
+  committed flight-recorder bundles are ``diag_push``-ed to server 0 and
+  pulled by rank 0 into one collected directory
+  (``<dir>/rank<R>/diag.rank<R>.<seq>.json`` — the layout
+  ``tools/diagnose.py`` expands), so no shared filesystem is needed.
+  Rank 0's :meth:`DiagCollector.request_pod_bundle` fans out an
+  on-demand capture: every rank's next ``tick()`` sees the request and
+  commits a bundle through the recorder's rate limiter — a live "pod
+  snapshot" for debugging a job that has not crashed yet.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+from . import watchdog as _watchdog
+from .. import log as _log
+
+__all__ = ["HealthPlane", "DiagCollector", "unique_component",
+           "set_ready", "clear_ready", "readiness", "is_ready", "reset"]
+
+
+# -- readiness registry (module level, mirrors watchdog's lanes) --------------
+
+_components = {}                # name -> bool (ready?)
+_components_lock = threading.Lock()
+
+_ready_gauge = _metrics.REGISTRY.gauge(
+    "mx_component_ready",
+    "1 when a registered component reports ready (warmup done), else 0",
+    labels=("component",))
+
+
+def unique_component(base):
+    """Claim a readiness slot not yet in use: ``base`` first, then
+    ``base#2``, ... (the watchdog ``unique_lane`` discipline — each
+    TrainStep/InferenceServer/DataPipeline instance owns its own slot,
+    so instance B's readiness can never mask instance A's warmup).
+    The new slot starts NOT ready."""
+    with _components_lock:
+        name = base
+        n = 2
+        while name in _components:
+            name = "%s#%d" % (base, n)
+            n += 1
+        _components[name] = False
+    _ready_gauge.labels(component=name).set(0)
+    return name
+
+
+def set_ready(name, ok=True):
+    """Flip a component's readiness (registers the slot if needed)."""
+    with _components_lock:
+        _components[name] = bool(ok)
+    _ready_gauge.labels(component=name).set(int(bool(ok)))
+
+
+def clear_ready(name):
+    """Drop a component slot (shutdown path) — a cycled server must not
+    leave a permanently not-ready ghost behind."""
+    with _components_lock:
+        _components.pop(name, None)
+    _ready_gauge.remove(component=name)
+
+
+def readiness():
+    """Plain ``{component: ready}`` view."""
+    with _components_lock:
+        return dict(_components)
+
+
+def is_ready():
+    """True when every registered component is ready (vacuously true
+    with none registered — a process with nothing warming up has
+    nothing to wait for)."""
+    with _components_lock:
+        return all(_components.values())
+
+
+def reset():
+    """Drop every component slot (test isolation)."""
+    with _components_lock:
+        names = list(_components)
+        _components.clear()
+    for name in names:
+        _ready_gauge.remove(component=name)
+
+
+# -- the endpoint handler ------------------------------------------------------
+
+class HealthPlane:
+    """JSON views over the forensics layer, mountable on a
+    :class:`~.metrics.MetricsServer` via
+    ``start_http_server(..., health=plane)``.
+
+    Parameters
+    ----------
+    watchdog : HangWatchdog, optional — supplies the per-lane deadline
+        policy ``/healthz`` evaluates (pass the instance already
+        scanning the process so probe and anomaly agree). Without one, a
+        private non-started HangWatchdog with default deadlines is used
+        purely for deadline arithmetic.
+    recorder : FlightRecorder, optional — backs ``POST /debug/bundle``
+        (404 without one).
+    pipelines : DataPipelines whose ``debug_state()`` feeds
+        ``/debug/pipeline`` (``watch_pipeline`` adds more).
+    """
+
+    def __init__(self, watchdog=None, recorder=None, pipelines=()):
+        self._watchdog = watchdog if watchdog is not None \
+            else _watchdog.HangWatchdog()
+        self._recorder = recorder
+        self._pipelines = list(pipelines)
+
+    def watch_pipeline(self, pipeline):
+        """Include a pipeline's ``debug_state()`` in ``/debug/pipeline``
+        (returns the pipeline)."""
+        self._pipelines.append(pipeline)
+        return pipeline
+
+    # -- probe bodies ---------------------------------------------------------
+
+    def healthz(self):
+        """Liveness: ``(healthy, body)``. Unhealthy exactly when a
+        watchdog lane's in-flight work is past its effective deadline —
+        the same arithmetic that fires ``*_hang`` anomalies, so the
+        probe flips within one deadline of a hang and recovers the
+        moment the lane completes. Idle lanes never count."""
+        lanes = {}
+        healthy = True
+        for name, state in _watchdog.lane_snapshot().items():
+            deadline = self._watchdog.deadline_for(name)
+            overdue = (state["busy_s"] is not None and deadline is not None
+                       and state["busy_s"] >= deadline)
+            lanes[name] = dict(state, deadline_s=deadline,
+                               overdue=overdue)
+            if overdue:
+                healthy = False
+        return healthy, {"healthy": healthy, "lanes": lanes}
+
+    def readyz(self):
+        """Readiness: ``(ready, body)`` over the component registry."""
+        components = readiness()
+        ready = all(components.values())
+        return ready, {"ready": ready, "components": components}
+
+    # -- debug views ----------------------------------------------------------
+
+    def stacks(self):
+        from . import recorder as _recorder
+
+        return {"threads": _recorder.thread_stacks()}
+
+    def pipeline_state(self):
+        out = []
+        for pipe in self._pipelines:
+            try:
+                out.append(pipe.debug_state())
+            except Exception as exc:
+                out.append({"error": repr(exc)})
+        return {"pipelines": out}
+
+    def memory(self):
+        from . import memstats as _memstats
+
+        try:
+            mem = _memstats.sample_device_memory(update_gauges=False)
+        except Exception as exc:
+            mem = {"error": repr(exc)}
+        return {"device_memory": mem,
+                "compile": _memstats.compile_stats()}
+
+    def trigger_bundle(self, kind="manual_http", msg="POST /debug/bundle"):
+        """Capture one local bundle NOW (no rate limit — this is the
+        operator asking). Returns the committed path or None."""
+        if self._recorder is None:
+            return None
+        return self._recorder.capture(kind, msg)
+
+    # -- HTTP routing (used by metrics.start_http_server) ---------------------
+
+    def handle(self, method, path):
+        """Route one request: returns ``(status, json_body)`` or None
+        for paths this plane does not own (the server falls through to
+        ``/metrics`` handling)."""
+        if method == "GET":
+            if path == "/healthz":
+                ok, body = self.healthz()
+                return (200 if ok else 503), body
+            if path == "/readyz":
+                ok, body = self.readyz()
+                return (200 if ok else 503), body
+            if path == "/debug/stacks":
+                return 200, self.stacks()
+            if path == "/debug/watchdog":
+                return 200, self.healthz()[1]
+            if path == "/debug/pipeline":
+                return 200, self.pipeline_state()
+            if path == "/debug/memory":
+                return 200, self.memory()
+        elif method == "POST" and path == "/debug/bundle":
+            if self._recorder is None:
+                return 404, {"error": "no FlightRecorder attached"}
+            bundle = self.trigger_bundle()
+            if bundle is None:
+                return 503, {"error": "bundle commit failed (see logs)"}
+            return 200, {"bundle": bundle}
+        return None
+
+
+# -- pod-wide forensics collection ---------------------------------------------
+
+_collected_total = _metrics.REGISTRY.counter(
+    "mx_diag_collected_total",
+    "Per-rank diagnostic bundles collected onto rank 0 over the kvstore",
+    labels=("rank",))
+
+
+class DiagCollector:
+    """Ship flight-recorder bundles over the kvstore command channel and
+    fan out pod-snapshot requests.
+
+    Parameters
+    ----------
+    kv : transport — ``rank`` plus the diag commands
+        (``diag_push(name, blob)``, ``diag_pull()``,
+        ``diag_request(kind, msg)``, ``diag_request_check()``):
+        ``KVStoreDist`` or a ``LocalBus`` endpoint.
+    recorder : this rank's FlightRecorder (bundle source, and the
+        rate limiter pod-snapshot requests run through).
+    directory : rank 0's collected-bundle root; each pulled bundle is
+        committed atomically to ``<directory>/rank<R>/<name>`` (the
+        layout ``tools/diagnose.py`` expands). Required on rank 0.
+    interval_s : ``tick()`` cadence.
+    clock : injectable monotonic clock for tests.
+
+    ``tick()`` from the step loop (or ``start()`` a daemon thread) does
+    three things, never raising: (1) answer a pending pod-snapshot
+    request by capturing a bundle through the recorder's per-kind rate
+    limiter; (2) push this rank's newly committed bundles to server 0;
+    (3) on rank 0, pull every rank's pushed bundles into ``directory``.
+    The server drains on pull and bounds its per-rank buffer, so a dead
+    rank 0 cannot make servers hoard bundles without bound.
+    """
+
+    def __init__(self, kv, recorder, directory=None, interval_s=5.0,
+                 clock=time.monotonic):
+        self._kv = kv
+        self._recorder = recorder
+        self.rank = int(getattr(kv, "rank", 0))
+        self.directory = directory
+        if self.rank == 0 and directory is None:
+            raise ValueError("rank 0 needs directory= to collect into")
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._last = None
+        self._pushed = 0            # recorder.bundles index already shipped
+        # Requests at/below this seq are handled; starts at 0 so a
+        # request issued moments before this rank joined still captures
+        # (a late-joining rank's fresh state is still a pod snapshot).
+        self._handled_seq = 0
+        self.collected = []         # paths rank 0 committed
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- the three duties -----------------------------------------------------
+
+    def poll_request(self):
+        """Answer an outstanding pod-snapshot request: capture one
+        bundle through the recorder's rate limiter (suppressed repeats
+        are counted, exactly like anomaly triggers). Returns the bundle
+        path when one was captured."""
+        seq, kind, msg = self._kv.diag_request_check()
+        if seq <= self._handled_seq:
+            return None
+        self._handled_seq = seq
+        return self._recorder.request(kind or "pod_snapshot", msg or "")
+
+    def push_new(self):
+        """Ship bundles committed since the last push to server 0.
+        Returns how many went out."""
+        bundles = self._recorder.bundles
+        sent = 0
+        while self._pushed < len(bundles):
+            path = bundles[self._pushed]
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                self._pushed += 1       # GC'd/unreadable: skip, move on
+                continue
+            self._kv.diag_push(os.path.basename(path), blob)
+            self._pushed += 1
+            sent += 1
+        return sent
+
+    def collect(self):
+        """Rank 0: drain every rank's pushed bundles into
+        ``directory/rank<R>/`` (atomic commit per file). Returns the
+        paths written this call."""
+        from . import export as _export
+
+        if self.rank != 0:
+            return []
+        written = []
+        for rank, bundles in sorted(self._kv.diag_pull().items()):
+            rank_dir = os.path.join(self.directory, "rank%d" % rank)
+            os.makedirs(rank_dir, exist_ok=True)
+            for name, blob in bundles:
+                path = os.path.join(rank_dir, os.path.basename(name))
+                _export.commit_bytes(path, blob)
+                written.append(path)
+                _collected_total.labels(rank=str(rank)).inc()
+        self.collected.extend(written)
+        return written
+
+    def request_pod_bundle(self, kind="pod_snapshot", msg=""):
+        """Fan out an on-demand capture to EVERY rank (rank 0's live
+        "dump the pod" button): posts the request on server 0; each
+        rank's next ``tick()``/:meth:`poll_request` captures and pushes.
+        Returns the request sequence number."""
+        return self._kv.diag_request(kind, msg)
+
+    # -- cadence --------------------------------------------------------------
+
+    def step(self):
+        """One unconditional round of all three duties (transport
+        errors propagate — ``tick()`` wraps them)."""
+        self.poll_request()
+        self.push_new()
+        return self.collect()
+
+    def tick(self):
+        """Step-loop cadence call: one round per ``interval_s``;
+        failures are warned rate-limited and retried next interval."""
+        now = self._clock()
+        if self._last is not None and now - self._last < self.interval_s:
+            return None
+        self._last = now
+        try:
+            return self.step()
+        except Exception as exc:
+            _log.warn_rate_limited(
+                _log.get_logger("mxnet_tpu.telemetry"),
+                "diag_collect:%d" % id(self), 30.0,
+                "diag collection round failed (will retry): %s", exc)
+            return None
+
+    def start(self):
+        """Run :meth:`step` every ``interval_s`` on a daemon thread
+        (returns self). Same thread-safety caveat as
+        ``Aggregator.start``: only drive a dist kvstore from here when
+        the training loop is not also using its connections."""
+        if self._thread is None:
+            self._stop.clear()
+
+            def loop():
+                while not self._stop.wait(self.interval_s):
+                    try:
+                        self.step()
+                    except Exception as exc:
+                        _log.warn_rate_limited(
+                            _log.get_logger("mxnet_tpu.telemetry"),
+                            "diag_collect:%d" % id(self), 30.0,
+                            "diag collection round failed (will retry): "
+                            "%s", exc)
+
+            self._thread = threading.Thread(
+                target=loop, name="mx-telemetry-diag", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self, timeout=5.0):
+        """Stop the background thread and run one final round (push
+        whatever committed last, collect whatever is pending)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        try:
+            self.step()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

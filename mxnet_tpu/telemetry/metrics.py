@@ -631,20 +631,56 @@ class MetricsServer:
         return False
 
 
-def start_http_server(port=0, addr="127.0.0.1", registry=None):
+def start_http_server(port=0, addr="127.0.0.1", registry=None,
+                      health=None):
     """Serve ``render_prometheus()`` on ``http://addr:port/metrics`` from
     a daemon thread (stdlib http.server; no dependencies). ``port=0``
     picks a free port. Returns a :class:`MetricsServer` handle — read
     the bound port from ``.port``/``.url``, stop with ``.close()``
     (which also joins the serving thread). ``registry`` accepts anything
     with a ``render_prometheus()`` method — a :class:`Registry` or a
-    :class:`~mxnet_tpu.telemetry.aggregate.Aggregator` fleet view."""
+    :class:`~mxnet_tpu.telemetry.aggregate.Aggregator` fleet view.
+
+    ``health`` mounts a
+    :class:`~mxnet_tpu.telemetry.healthplane.HealthPlane` next to
+    ``/metrics``: ``GET /healthz`` / ``/readyz`` (liveness/readiness
+    probes — 200 or 503 with a JSON body) and the ``/debug/*`` views
+    (``stacks``/``watchdog``/``pipeline``/``memory`` plus ``POST
+    /debug/bundle``). ``/metrics`` exposition — including the
+    OpenMetrics Accept negotiation — is unchanged."""
+    import json as _json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry or REGISTRY
 
     class _Handler(BaseHTTPRequestHandler):
+        def _try_health(self, method):
+            if health is None:
+                return False
+            try:
+                routed = health.handle(method,
+                                       self.path.split("?", 1)[0])
+            except Exception as exc:    # a probe must never hang/close
+                routed = (500, {"error": repr(exc)})
+            if routed is None:
+                return False
+            status, obj = routed
+            body = _json.dumps(obj, default=str).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type",
+                             "application/json; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return True
+
+        def do_POST(self):
+            if not self._try_health("POST"):
+                self.send_error(404)
+
         def do_GET(self):
+            if self._try_health("GET"):
+                return
             if self.path.split("?", 1)[0] not in ("/metrics", "/"):
                 self.send_error(404)
                 return

@@ -56,7 +56,8 @@ from . import metrics as _metrics
 from . import trace as _trace
 from .. import log as _log
 
-__all__ = ["FlightRecorder", "DIAG_FORMAT", "DIAG_RE", "bundle_name"]
+__all__ = ["FlightRecorder", "DIAG_FORMAT", "DIAG_RE", "bundle_name",
+           "thread_stacks"]
 
 DIAG_FORMAT = "mxnet_tpu.diag_bundle/1"
 DIAG_RE = re.compile(r"^diag\.rank(\d+)\.(\d+)\.json$")
@@ -75,7 +76,7 @@ def bundle_name(rank, seq):
     return "diag.rank%d.%06d.json" % (rank, seq)
 
 
-def _thread_stacks():
+def thread_stacks():
     """Structured stacks of every live thread, innermost frame last."""
     frames = sys._current_frames()
     meta = {t.ident: t for t in threading.enumerate()}
@@ -210,6 +211,17 @@ class FlightRecorder:
                 self._last_fire[kind] = now
         return path
 
+    def request(self, kind, msg=""):
+        """Rate-limited capture request — the same per-kind limiter +
+        history path an anomaly trigger takes, for external requesters
+        (the pod-snapshot fan-out in
+        :class:`~mxnet_tpu.telemetry.healthplane.DiagCollector`): a
+        snapshot storm from a flapping operator produces a bounded
+        bundle stream, with suppressed requests counted onto the next
+        bundle. Returns the committed path, or None when suppressed or
+        the commit failed."""
+        return self._on_anomaly(kind, msg)
+
     def capture(self, kind="manual", msg=""):
         """Collect and atomically commit one bundle NOW (no rate
         limit). Returns the committed path, or None on commit failure
@@ -271,7 +283,7 @@ class FlightRecorder:
                 "recorder_started": self._started_wall,
                 "suppressed_since_last": dict(self._suppressed),
             },
-            "threads": self._safe("threads", _thread_stacks),
+            "threads": self._safe("threads", thread_stacks),
             "spans": self._safe("spans", self._span_tail),
             "registry": self._safe(
                 "registry",

@@ -107,6 +107,20 @@ class ServiceLevelObjective:
         idx = bisect_left(fam.buckets, self.threshold_s)
         return fam.buckets[idx] if idx < len(fam.buckets) else float("inf")
 
+    @staticmethod
+    def _label_key(fam, key):
+        # Fleet-registry quirk (aggregate._rank_label): when a family
+        # already used "rank" natively, the merge labels the source
+        # process under "src_rank" — a fleet SLO filtering on
+        # rank="all" must follow the label there. "src_rank" only ever
+        # exists as the merge's process label, so its presence alone
+        # decides (the native "rank" label is still in labelnames, so
+        # checking `key not in labelnames` would never redirect in
+        # exactly the case this fallback exists for).
+        if key == "rank" and "src_rank" in fam.labelnames:
+            return "src_rank"
+        return key
+
     def totals(self):
         """Cumulative ``(bad, total)`` across every child of the family
         (0, 0 until the family exists / has traffic)."""
@@ -118,7 +132,8 @@ class ServiceLevelObjective:
         for values, child in fam.collect():
             if self._labels:
                 lv = dict(zip(fam.labelnames, values))
-                if any(lv.get(k) != v for k, v in self._labels.items()):
+                if any(lv.get(self._label_key(fam, k)) != v
+                       for k, v in self._labels.items()):
                     continue
             snap = child.snapshot()
             total += snap["count"]

@@ -47,7 +47,7 @@ __all__ = ["HangWatchdog", "begin", "end", "unique_lane",
 
 # Anomaly kind per instrumented lane; unknown lanes fire "<name>_hang".
 DEFAULT_KINDS = {"step": "step_hang", "serving": "serving_hang",
-                 "checkpoint": "checkpoint_hang"}
+                 "checkpoint": "checkpoint_hang", "data": "data_hang"}
 
 _fired_total = _metrics.REGISTRY.counter(
     "mx_watchdog_fired_total",
@@ -73,6 +73,7 @@ class _Lane:
 
 
 _lanes = {}     # name -> _Lane; plain dict, GIL-atomic get/set
+_claim_lock = threading.Lock()      # serializes unique_lane claims only
 
 
 def _lane(name):
@@ -94,17 +95,19 @@ def unique_lane(base):
     instance B's completion clear instance A's in-flight marker and
     silently mask A's hang. Deadline/kind overrides and the anomaly
     kind resolve by the ``base`` prefix (``serving#2`` still fires
-    ``serving_hang``). Construction-time use only (claiming is not
-    atomic against a concurrent claim of the same base)."""
-    if base not in _lanes:
-        _lane(base)
-        return base
-    n = 2
-    while "%s#%d" % (base, n) in _lanes:
-        n += 1
-    name = "%s#%d" % (base, n)
-    _lane(name)
-    return name
+    ``serving_hang``). Claims are serialized by a module lock — decode
+    workers and the prefetch thread claim ``data`` lanes concurrently
+    at runtime, not just at construction."""
+    with _claim_lock:
+        if base not in _lanes:
+            _lane(base)
+            return base
+        n = 2
+        while "%s#%d" % (base, n) in _lanes:
+            n += 1
+        name = "%s#%d" % (base, n)
+        _lane(name)
+        return name
 
 
 def begin(name):

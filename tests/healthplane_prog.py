@@ -1,0 +1,99 @@
+"""Worker program for the 2-process fleet-health-plane acceptance test
+(tests/test_healthplane.py, launched via tools/launch.py roles).
+
+Proves the two ISSUE 8 acceptance properties over a REAL dist kvstore:
+
+* **Pod snapshot without a shared filesystem.** Each rank commits its
+  flight-recorder bundles into its own private directory; rank 0's
+  ``request_pod_bundle`` fan-out makes every rank capture on demand and
+  ``diag_push`` the bundle over the kvstore; rank 0 collects one bundle
+  per rank into ``collected/rank<R>/``.
+* **Fleet-level SLO evaluation.** Rank 0 observes only fast probes,
+  rank 1 only slow ones — neither rank's own series crosses the SLO
+  alone in an alarming way; the rank-0 BurnRateMonitor evaluates the
+  merged ``rank="all"`` histogram and fires exactly one ``slo_burn``
+  alert for the pod's combined 50% error rate.
+"""
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import mxnet_tpu as mx                                  # noqa: E402
+from mxnet_tpu import telemetry                         # noqa: E402
+from mxnet_tpu.telemetry import healthplane as hp       # noqa: E402
+from mxnet_tpu.telemetry import metrics as tm           # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    kv = mx.kvstore.create("dist_sync")
+    rank = kv.rank
+
+    # Each rank's recorder writes to a PRIVATE directory — nothing
+    # below may rely on peers reading it.
+    local_dir = os.path.join(out_dir, "local_rank%d" % rank)
+    recorder = telemetry.FlightRecorder(local_dir, rank=rank,
+                                        rate_limit_s=0.0)
+    collector = hp.DiagCollector(
+        kv, recorder, interval_s=0.0,
+        directory=os.path.join(out_dir, "collected") if rank == 0
+        else None)
+
+    lat = tm.REGISTRY.histogram("podhp_latency_seconds",
+                                "synthetic probe latency",
+                                buckets=(0.1, 1.0))
+    aggregator = telemetry.Aggregator(kv, interval_s=0.0)
+    monitor = telemetry.StepMonitor(warn_interval_s=1e9)
+    burn = telemetry.BurnRateMonitor(
+        monitor=monitor, eval_interval_s=0.0,
+        registry=tm.Registry())     # gauges stay out of the pushed snapshot
+    burn.add(aggregator.fleet_slo("pod_latency", 0.99, 0.1,
+                                  "podhp_latency_seconds"))
+
+    # Baseline SLO sample BEFORE any traffic (cumulative differencing).
+    if rank == 0:
+        burn.evaluate(now=1_000_000.0)
+
+    # Traffic: rank 0 is 100% good (50 ms <= 100 ms threshold), rank 1
+    # is 100% bad (500 ms) — the pod is 50% bad, burn 0.5/0.01 = 50x.
+    for _ in range(50):
+        lat.observe(0.05 if rank == 0 else 0.5)
+    aggregator.step()               # push this rank's snapshot
+    kv._barrier()                   # both snapshots have landed
+
+    if rank == 0:
+        aggregator.step()           # pull + merge the pod view
+        # ONE evaluation pass over the merged view -> exactly one
+        # pod-level alert (not one per rank); a continuing burn would
+        # keep re-firing on later passes, Prometheus-style.
+        burns = burn.evaluate(now=1_000_060.0)
+        with open(os.path.join(out_dir, "slo.txt"), "w") as f:
+            f.write(json.dumps({
+                "alerts": monitor.anomaly_counts.get("slo_burn", 0),
+                "burn_5m": burns["pod_latency"]["5m"],
+                "merged_p99": aggregator.merged_quantile(
+                    "podhp_latency_seconds", 0.99),
+            }))
+
+    # -- pod snapshot over the kvstore ----------------------------------------
+    if rank == 0:
+        collector.request_pod_bundle("pod_snapshot",
+                                     "acceptance pod snapshot")
+    kv._barrier()                   # request is posted before anyone polls
+    collector.step()                # every rank: poll -> capture -> push
+    assert recorder.bundles, "rank %d captured no bundle" % rank
+    kv._barrier()                   # all pushes processed server-side
+    if rank == 0:
+        collector.collect()         # drain whatever landed by now
+        with open(os.path.join(out_dir, "collected.txt"), "w") as f:
+            f.write("\n".join(sorted(collector.collected)))
+    kv._barrier()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

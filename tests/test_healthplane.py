@@ -1,0 +1,664 @@
+"""Fleet health plane (ISSUE 8): push-gateway metric export, fleet-level
+SLO evaluation on the merged registry, pod-wide forensics collection
+over the kvstore diag channel, live /healthz-/readyz-/debug endpoints on
+the MetricsServer, data-pipeline watchdog lanes, and the bench
+compile-accounting diff."""
+import importlib.util
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+from mxnet_tpu.data.decode import DecodePool
+from mxnet_tpu.data.prefetch import DevicePrefetcher
+from mxnet_tpu.telemetry import aggregate, export
+from mxnet_tpu.telemetry import healthplane as hp
+from mxnet_tpu.telemetry import metrics as tmetrics
+from mxnet_tpu.telemetry import watchdog as twd
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+from launch import launch_local  # noqa: E402
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name, path=None):
+    """Import a repo script as a module (the test_forensics pattern)."""
+    spec = importlib.util.spec_from_file_location(
+        name, path or os.path.join(_ROOT, "tools", "%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    twd.reset()
+    hp.reset()
+    yield
+    twd.reset()
+    hp.reset()
+
+
+def _can_bind_localhost():
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+def _http(url, method="GET", accept=None):
+    """(status, body_bytes) — 4xx/5xx come back as values, not raises."""
+    headers = {"Accept": accept} if accept else {}
+    req = urllib.request.Request(url, method=method, headers=headers,
+                                 data=b"" if method == "POST" else None)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# -- push exporter ------------------------------------------------------------
+
+def test_push_exporter_posts_gateway_url_and_body():
+    reg = tmetrics.Registry()
+    reg.counter("pushex_probe_total").inc(7)
+    sent = []
+    exporter = export.PushExporter(
+        "http://gw:9091", registry=reg, job="trainer", instance="r0",
+        transport=lambda url, body: sent.append((url, body)))
+    assert exporter.push() is True
+    url, body = sent[0]
+    assert url == "http://gw:9091/metrics/job/trainer/instance/r0"
+    assert b"pushex_probe_total 7" in body
+    assert exporter.pending == 0
+
+
+def test_push_exporter_gateway_down_backoff_and_bounded_buffer():
+    """ISSUE 8 satellite: gateway 500s -> exponential backoff between
+    attempts, bounded buffer (oldest dropped), failures counted; a
+    recovered gateway drains the backlog in order."""
+    reg = tmetrics.Registry()
+    beat = reg.counter("pushex_beat_total")
+    clock = _FakeClock()
+    calls = []
+    healthy = [False]
+
+    def transport(url, body):
+        calls.append(body)
+        if not healthy[0]:
+            raise OSError("HTTP 500 from gateway")
+
+    fail0 = tmetrics.REGISTRY.get("mx_export_failures_total").value
+    exporter = export.PushExporter(
+        "http://gw:9091", registry=reg, interval_s=10.0, max_buffer=3,
+        backoff_s=1.0, max_backoff_s=4.0, transport=transport,
+        clock=clock)
+
+    beat.inc()
+    exporter.tick()                         # t=0: render + attempt 1
+    assert len(calls) == 1 and exporter.pending == 1
+    clock.t = 0.5
+    exporter.tick()                         # inside backoff: no attempt
+    assert len(calls) == 1
+    clock.t = 1.5
+    exporter.tick()                         # backoff passed: attempt 2
+    assert len(calls) == 2
+    assert tmetrics.REGISTRY.get("mx_export_failures_total").value \
+        - fail0 == 2
+    # Backoff doubled (2s): the t=2.0 retry is suppressed.
+    clock.t = 2.0
+    exporter.tick()
+    assert len(calls) == 2
+
+    # Fill past the buffer bound: only the newest 3 snapshots survive.
+    for i in range(5):
+        clock.t = 100.0 + 10.0 * i          # each tick renders one more
+        beat.inc()
+        exporter.tick()
+    assert exporter.pending == 3
+
+    healthy[0] = True
+    clock.t = 1000.0
+    exporter.tick()                         # drains the whole backlog
+    assert exporter.pending == 0
+    # Delivered oldest-first: the last three delivered bodies are the
+    # three newest snapshots, in render order.
+    def _beat(body):
+        for line in body.splitlines():
+            if line.startswith(b"pushex_beat_total"):
+                return int(line.split()[-1])
+
+    counts = [_beat(b) for b in calls[-3:]]
+    assert counts == sorted(counts) and counts[-1] == 6
+    # Recovered: next failure starts from the base backoff again.
+    healthy[0] = False
+    clock.t = 1010.0
+    exporter.tick()
+    assert exporter._backoff == 1.0
+
+
+def test_push_exporter_tick_never_blocks_behind_inflight_delivery():
+    """A slow/blackholing gateway must not stall a step-loop tick():
+    the network call runs outside the state lock, and a tick that finds
+    another thread mid-delivery skips instead of queueing behind it."""
+    reg = tmetrics.Registry()
+    reg.counter("pushex_slow_total").inc()
+    in_post = threading.Event()
+    release = threading.Event()
+
+    def transport(url, body):
+        in_post.set()
+        assert release.wait(10.0)
+
+    exporter = export.PushExporter(
+        "http://gw:9091", registry=reg, interval_s=0.0,
+        transport=transport)
+    t = threading.Thread(target=exporter.push, daemon=True)
+    t.start()
+    assert in_post.wait(10.0)               # delivery now in flight
+    t0 = time.perf_counter()
+    assert exporter.tick() is None          # skips, doesn't queue
+    assert exporter.pending >= 1            # state lock was free too
+    assert time.perf_counter() - t0 < 5.0
+    release.set()
+    t.join(10.0)
+    assert not t.is_alive()
+
+
+def test_diag_buffer_bound_zero_keeps_nothing(monkeypatch):
+    """bound <= 0 means keep NOTHING — the naive del q[:-0] would keep
+    everything, turning the anti-hoard bound into an unbounded buffer."""
+    bus = aggregate.LocalBus()
+    monkeypatch.setattr(type(bus), "MAX_DIAG_PER_RANK", 0)
+    for i in range(4):
+        bus.diag_push(1, "diag.%d.json" % i, b"{}")
+    assert bus.diag_pull() in ({}, {1: []})
+    monkeypatch.setattr(type(bus), "MAX_DIAG_PER_RANK", 2)
+    for i in range(5):
+        bus.diag_push(1, "diag.%d.json" % i, b"{}")
+    assert [n for n, _ in bus.diag_pull()[1]] == \
+        ["diag.3.json", "diag.4.json"]
+
+
+# -- readiness + healthz ------------------------------------------------------
+
+def test_readiness_registry_unique_components():
+    a = hp.unique_component("serving")
+    b = hp.unique_component("serving")
+    assert (a, b) == ("serving", "serving#2")
+    assert hp.is_ready() is False           # both start not-ready
+    hp.set_ready(a)
+    assert hp.is_ready() is False
+    hp.set_ready(b)
+    assert hp.is_ready() is True
+    hp.clear_ready(a)
+    hp.clear_ready(b)
+    assert hp.readiness() == {} and hp.is_ready() is True  # vacuous
+
+
+def test_healthz_flips_within_one_deadline_and_recovers():
+    """ISSUE 8 test satellite: /healthz goes unhealthy within one
+    watchdog deadline of an induced hang and recovers the moment the
+    lane completes."""
+    plane = hp.HealthPlane(
+        watchdog=telemetry.HangWatchdog(min_deadline_s=0.05))
+    ok, body = plane.healthz()
+    assert ok and body["healthy"]
+
+    twd.begin("step")
+    ok, _ = plane.healthz()                 # fresh work: still healthy
+    assert ok
+    time.sleep(0.06)                        # one deadline later
+    ok, body = plane.healthz()
+    assert not ok
+    assert body["lanes"]["step"]["overdue"] is True
+    assert body["lanes"]["step"]["deadline_s"] == pytest.approx(0.05)
+
+    twd.end("step")
+    ok, body = plane.healthz()              # lane completed: recovered
+    assert ok and not body["lanes"]["step"]["overdue"]
+
+
+def test_train_step_and_serving_flip_ready():
+    from mxnet_tpu import gluon, serving
+    from mxnet_tpu.parallel import TrainStep, make_mesh
+
+    net = gluon.nn.Dense(4, in_units=4)
+    net.initialize()
+    step = TrainStep(net, gluon.loss.L2Loss(), optimizer="sgd",
+                     mesh=make_mesh())
+    # The slot is claimed lazily at the FIRST __call__: a TrainStep
+    # built but never stepped (eval-only, a discarded retune) must not
+    # leave a permanently not-ready ghost in /readyz.
+    assert step._hp_component is None
+    assert not any(c.startswith("train_step")
+                   for c in hp.readiness())
+    batch = 2 * len(step.mesh.devices.flat)   # divisible by the dp axis
+    step(np.ones((batch, 4), np.float32),
+         np.zeros((batch, 4), np.float32))
+    assert hp.readiness()[step._hp_component] is True
+
+    srv = serving.InferenceServer(
+        fn=lambda w, x: x * w, params=[nd.array(np.ones((1,), "float32"))],
+        item_shape=(1,), max_batch=4, warmup=True)
+    try:
+        assert hp.readiness()[srv._hp_component] is True  # ladder warm
+    finally:
+        srv.shutdown()
+    assert srv._hp_component not in hp.readiness()  # slot released
+
+
+# -- HTTP endpoints on the MetricsServer --------------------------------------
+
+def test_metrics_server_health_and_debug_endpoints(tmp_path):
+    """The full endpoint table on ONE server, plus the /metrics
+    Accept-negotiation regression with the health plane mounted."""
+    if not _can_bind_localhost():
+        pytest.skip("localhost sockets unavailable")
+    recorder = telemetry.FlightRecorder(str(tmp_path), rank=0,
+                                        rate_limit_s=0.0)
+
+    class _Pipe:
+        def debug_state(self):
+            return {"watermark": {"epoch": 1}, "last_batch": {"ids": [3]}}
+
+    plane = hp.HealthPlane(
+        watchdog=telemetry.HangWatchdog(min_deadline_s=0.05),
+        recorder=recorder)
+    plane.watch_pipeline(_Pipe())
+    tmetrics.REGISTRY.counter("hp_endpoint_probe_total").inc(2)
+    server = telemetry.start_http_server(0, health=plane)
+    base = "http://%s:%d" % server.server_address
+    try:
+        # /metrics negotiation unchanged with health mounted.
+        status, body = _http(base + "/metrics")
+        assert status == 200 and b"hp_endpoint_probe_total 2" in body
+        assert b"# EOF" not in body
+        status, body = _http(base + "/metrics",
+                             accept="application/openmetrics-text")
+        assert status == 200 and body.rstrip().endswith(b"# EOF")
+
+        status, body = _http(base + "/healthz")
+        assert status == 200 and json.loads(body)["healthy"] is True
+
+        # Induce a hang: liveness flips 503 within one deadline.
+        twd.begin("step")
+        time.sleep(0.06)
+        status, body = _http(base + "/healthz")
+        assert status == 503 and json.loads(body)["healthy"] is False
+        twd.end("step")
+        status, _ = _http(base + "/healthz")
+        assert status == 200
+
+        comp = hp.unique_component("warming")
+        status, body = _http(base + "/readyz")
+        assert status == 503
+        assert json.loads(body)["components"] == {"warming": False}
+        hp.set_ready(comp)
+        status, body = _http(base + "/readyz")
+        assert status == 200 and json.loads(body)["ready"] is True
+
+        status, body = _http(base + "/debug/stacks")
+        names = [t["name"] for t in json.loads(body)["threads"]]
+        assert status == 200 and "MainThread" in names
+        status, body = _http(base + "/debug/watchdog")
+        assert status == 200 and "step" in json.loads(body)["lanes"]
+        status, body = _http(base + "/debug/pipeline")
+        assert json.loads(body)["pipelines"][0]["last_batch"]["ids"] == [3]
+        status, body = _http(base + "/debug/memory")
+        payload = json.loads(body)
+        assert status == 200 and "device_memory" in payload \
+            and "compile" in payload
+
+        status, body = _http(base + "/debug/bundle", method="POST")
+        bundle = json.loads(body)["bundle"]
+        assert status == 200 and os.path.exists(bundle)
+        with open(bundle) as f:
+            assert json.load(f)["meta"]["kind"] == "manual_http"
+
+        assert _http(base + "/nonsense")[0] == 404
+        assert _http(base + "/nonsense", method="POST")[0] == 404
+    finally:
+        server.close()
+
+
+def test_metrics_server_without_health_post_404():
+    if not _can_bind_localhost():
+        pytest.skip("localhost sockets unavailable")
+    server = telemetry.start_http_server(0)
+    base = "http://%s:%d" % server.server_address
+    try:
+        assert _http(base + "/healthz")[0] == 404
+        assert _http(base + "/debug/bundle", method="POST")[0] == 404
+        assert _http(base + "/metrics")[0] == 200
+    finally:
+        server.close()
+
+
+# -- diag collection over the LocalBus ----------------------------------------
+
+def _collectors(tmp_path, rate_limit_s=0.0):
+    bus = aggregate.LocalBus(num_workers=2)
+    out = []
+    for rank in (0, 1):
+        rec = telemetry.FlightRecorder(
+            str(tmp_path / ("local%d" % rank)), rank=rank,
+            rate_limit_s=rate_limit_s)
+        out.append(hp.DiagCollector(
+            bus.endpoint(rank), rec, interval_s=0.0,
+            directory=str(tmp_path / "collected") if rank == 0 else None))
+    return out
+
+
+def test_pod_snapshot_collects_one_bundle_per_rank(tmp_path):
+    c0, c1 = _collectors(tmp_path)
+    assert c0.request_pod_bundle("pod_snapshot", "dump the pod") == 1
+    c1.step()                               # rank 1: capture + push
+    c0.step()                               # rank 0: capture+push+collect
+    collected = sorted(c0.collected)
+    assert len(collected) == 2
+    for rank, path in enumerate(collected):
+        assert os.path.dirname(path).endswith("rank%d" % rank)
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["meta"]["kind"] == "pod_snapshot"
+        assert bundle["meta"]["rank"] == rank
+    # Drain semantics: nothing re-collects without new pushes.
+    assert c0.collect() == []
+
+
+def test_pod_snapshot_requests_ride_recorder_rate_limit(tmp_path):
+    c0, c1 = _collectors(tmp_path, rate_limit_s=1e9)
+    c0.request_pod_bundle()
+    c1.step()
+    c0.step()
+    assert len(c0.collected) == 2
+    suppressed0 = tmetrics.REGISTRY.get("mx_diag_suppressed_total") \
+        .labels(kind="pod_snapshot").value
+    c0.request_pod_bundle()                 # a flapping operator
+    c1.step()
+    c0.step()
+    assert len(c0.collected) == 2           # no new bundles
+    assert tmetrics.REGISTRY.get("mx_diag_suppressed_total") \
+        .labels(kind="pod_snapshot").value - suppressed0 == 2
+
+
+def test_diagnose_expands_collected_layout_and_merges(tmp_path, capsys):
+    """ISSUE 8 satellite: tools/diagnose.py reads the rank-0 collected
+    tree (rank<R>/ subdirs) and --merges it with a locally committed
+    bundle directory into one incident."""
+    c0, c1 = _collectors(tmp_path)
+    c0.request_pod_bundle("pod_snapshot", "incident probe")
+    c1.step()
+    c0.step()
+    # A local-only bundle of the same kind, moments later.
+    local_extra = tmp_path / "local_extra"
+    rec = telemetry.FlightRecorder(str(local_extra), rank=2,
+                                   rate_limit_s=0.0)
+    rec.capture("pod_snapshot", "local capture")
+
+    diagnose = _tool("diagnose")
+    found = diagnose._expand([str(tmp_path / "collected")])
+    assert len(found) == 2 and all(p.endswith(".json") for p in found)
+
+    rc = diagnose.main(["--merge", str(tmp_path / "collected"),
+                        str(local_extra)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "INCIDENT kind=pod_snapshot" in out
+    assert "rank(s) [0, 1, 2]" in out
+    assert "3 bundle(s) summarized" in out
+
+
+# -- fleet SLO evaluation -----------------------------------------------------
+
+def test_fleet_slo_alerts_on_merged_rank_all_series():
+    """The rank-0 BurnRateMonitor evaluates the pod's combined traffic:
+    rank 0 all-good + rank 1 all-bad = 50% pod error rate -> one
+    alert, even though rank 0's own series is clean."""
+    bus = aggregate.LocalBus(num_workers=2)
+    regs = [tmetrics.Registry() for _ in range(2)]
+    aggs = [telemetry.Aggregator(bus.endpoint(r), registry=regs[r],
+                                 interval_s=0.0) for r in range(2)]
+    fams = [reg.histogram("fleet_lat_seconds", "latency",
+                          buckets=(0.1, 1.0)) for reg in regs]
+
+    monitor = telemetry.StepMonitor(warn_interval_s=1e9)
+    burn = telemetry.BurnRateMonitor(monitor=monitor, eval_interval_s=0.0,
+                                     registry=tmetrics.Registry())
+    slo = burn.add(aggs[0].fleet_slo("fleet", 0.99, 0.1,
+                                     "fleet_lat_seconds"))
+    burn.evaluate(now=1000.0)               # baseline: no fleet yet
+
+    for _ in range(50):
+        fams[0].observe(0.05)               # rank 0: good
+        fams[1].observe(0.5)                # rank 1: bad
+    aggs[1].step()
+    aggs[0].step()                          # merge -> rank="all" series
+    assert slo.effective_threshold == pytest.approx(0.1)
+    burns = burn.evaluate(now=1060.0)
+    assert burns["fleet"]["5m"] == pytest.approx(50.0)
+    assert monitor.anomaly_counts.get("slo_burn") == 1
+
+    # Per-rank scoping still works off the same fleet view: rank 0
+    # alone is 0% bad.
+    solo = telemetry.ServiceLevelObjective(
+        "solo", 0.99, 0.1, "fleet_lat_seconds", labels={"rank": "0"},
+        registry=aggs[0])
+    assert solo.totals() == (0, 50)
+
+
+def test_fleet_slo_follows_src_rank_for_natively_rank_labeled_family():
+    """When the histogram already uses a "rank" label natively, the
+    merge files the source process under "src_rank" — the fleet SLO's
+    rank="all" filter must follow it there (regression: the redirect
+    used to require "rank" absent from labelnames, which is never true
+    in exactly this case, so totals() was silently (0, 0))."""
+    bus = aggregate.LocalBus(num_workers=2)
+    regs = [tmetrics.Registry() for _ in range(2)]
+    aggs = [telemetry.Aggregator(bus.endpoint(r), registry=regs[r],
+                                 interval_s=0.0) for r in range(2)]
+    fams = [reg.histogram("fleet_ranked_lat_seconds", "latency",
+                          labels=("rank",), buckets=(0.1, 1.0))
+            for reg in regs]
+    for _ in range(10):
+        fams[0].labels(rank="x").observe(0.05)
+        fams[1].labels(rank="y").observe(0.5)
+    aggs[1].step()
+    aggs[0].step()
+    slo = aggs[0].fleet_slo("ranked", 0.99, 0.1,
+                            "fleet_ranked_lat_seconds")
+    assert slo.totals() == (10, 20)
+
+
+def test_push_exporter_backoff_resets_on_any_successful_delivery():
+    """A flapping gateway that accepts every other POST must not climb
+    toward max_backoff_s: ANY success resets the backoff to base."""
+    reg = tmetrics.Registry()
+    beat = reg.counter("pushex_flap_total")
+    clock = _FakeClock()
+    flip = [False]
+
+    def transport(url, body):
+        flip[0] = not flip[0]
+        if not flip[0]:
+            raise OSError("gateway flapped")
+
+    exporter = export.PushExporter(
+        "http://gw:9091", registry=reg, interval_s=1.0, max_buffer=8,
+        backoff_s=1.0, max_backoff_s=300.0, transport=transport,
+        clock=clock)
+    for i in range(12):
+        clock.t = 10.0 * (i + 1)
+        beat.inc()
+        exporter.tick()
+        assert exporter._backoff in (None, 1.0)
+
+
+# -- data-pipeline watchdog lanes ---------------------------------------------
+
+def test_decode_pool_hang_fires_data_hang_and_close_releases_lanes():
+    """ISSUE 8 satellite: a wedged decode worker fires `data_hang`
+    (was: visible only as data::wait); close() releases the lanes."""
+    release = threading.Event()
+
+    def fn(i):
+        if i == 0:
+            release.wait(5.0)
+        return i
+
+    pool = DecodePool(fn, num_threads=2, ordered=True)
+    results = []
+    consumer = threading.Thread(
+        target=lambda: results.extend(pool.run(range(4))), daemon=True)
+    consumer.start()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        lanes = twd.lane_snapshot()
+        if any(n.split("#")[0] == "data" and s["busy_s"] is not None
+               for n, s in lanes.items()):
+            break
+        time.sleep(0.01)
+    monitor = telemetry.StepMonitor(warn_interval_s=1e9)
+    watchdog = telemetry.HangWatchdog(monitor=monitor,
+                                      min_deadline_s=0.01)
+    time.sleep(0.05)
+    fired = watchdog.check()
+    assert any(n.split("#")[0] == "data" for n in fired), fired
+    assert monitor.anomaly_counts.get("data_hang", 0) >= 1
+
+    release.set()
+    consumer.join(5.0)
+    assert sorted(results) == [0, 1, 2, 3]
+    pool.close()
+    assert not any(n.split("#")[0] == "data"
+                   for n in twd.lane_snapshot())
+
+
+def test_prefetcher_stall_fires_data_hang_and_close_releases_lane():
+    release = threading.Event()
+
+    def source():
+        yield {"x": 1}
+        release.wait(5.0)
+        yield {"x": 2}
+
+    prefetcher = DevicePrefetcher(source(), depth=2, place=None)
+    assert next(prefetcher) == {"x": 1}
+    deadline = time.time() + 5.0
+    while time.time() < deadline:           # producer wedged in source
+        lanes = twd.lane_snapshot()
+        if lanes.get("data", {}).get("busy_s") is not None:
+            break
+        time.sleep(0.01)
+    monitor = telemetry.StepMonitor(warn_interval_s=1e9)
+    watchdog = telemetry.HangWatchdog(monitor=monitor,
+                                      min_deadline_s=0.01)
+    time.sleep(0.05)
+    assert "data" in watchdog.check()
+    assert monitor.anomaly_counts.get("data_hang", 0) >= 1
+    release.set()
+    assert next(prefetcher) == {"x": 2}
+    prefetcher.close()
+    assert "data" not in twd.lane_snapshot()
+
+
+# -- bench compile-accounting diff --------------------------------------------
+
+def test_bench_compare_emits_per_site_deltas(tmp_path, capsys):
+    bench = _tool("bench", os.path.join(_ROOT, "bench.py"))
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(
+        json.dumps({"metric": "compile_count[train_step]", "value": 3,
+                    "unit": "compiles"}) + "\n" +
+        json.dumps({"metric": "compile_seconds[train_step]",
+                    "value": 4.5, "unit": "s"}) + "\n" +
+        "stderr noise that is not json\n")
+    b.write_text(
+        json.dumps({"metric": "compile_count[train_step]", "value": 0,
+                    "unit": "compiles"}) + "\n" +
+        json.dumps({"metric": "compile_seconds[train_step]",
+                    "value": 0.0, "unit": "s"}) + "\n" +
+        json.dumps({"metric": "compile_count[cached_op]", "value": 2,
+                    "unit": "compiles"}) + "\n")
+    assert bench.compare(str(a), str(b)) == 0
+    rows = {r["metric"]: r for r in
+            map(json.loads, capsys.readouterr().out.splitlines())}
+    assert rows["compile_count_delta[train_step]"]["value"] == -3.0
+    assert rows["compile_seconds_delta[train_step]"]["value"] == -4.5
+    assert rows["compile_count_delta[cached_op]"]["value"] == 2.0
+    assert rows["compile_count_delta_total"]["value"] == -1.0
+    # No accounting rows at all -> explicit error row, rc 1.
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}\n")
+    assert bench.compare(str(empty), str(empty)) == 1
+
+
+# -- 2-process acceptance -----------------------------------------------------
+
+_PROG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "healthplane_prog.py")
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+}
+
+
+def test_two_process_pod_snapshot_and_fleet_slo(tmp_path):
+    """ISSUE 8 acceptance: a rank-0 `request_bundle` pod snapshot
+    yields one diag bundle per rank collected over the kvstore (each
+    rank's recorder wrote only its private directory), and a fleet SLO
+    violation synthesized across both ranks' histograms fires exactly
+    one alert from the rank-0 monitor."""
+    if not _can_bind_localhost():
+        pytest.skip("localhost sockets unavailable (multi-process "
+                    "kvstore needs them)")
+    codes = launch_local(2, 1, [sys.executable, _PROG, str(tmp_path)],
+                         env_extra=_ENV, timeout=300)
+    assert codes == [0, 0], codes
+
+    slo = json.loads((tmp_path / "slo.txt").read_text())
+    assert slo["alerts"] == 1               # exactly one pod-level alert
+    assert slo["burn_5m"] == pytest.approx(50.0)
+    assert 0.1 < slo["merged_p99"] <= 1.0   # pod p99 is in the bad bucket
+
+    collected = [l for l in
+                 (tmp_path / "collected.txt").read_text().splitlines()
+                 if l]
+    assert len(collected) == 2, collected
+    ranks = set()
+    for path in collected:
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["meta"]["kind"] == "pod_snapshot"
+        ranks.add(bundle["meta"]["rank"])
+        assert os.path.dirname(path).endswith(
+            "rank%d" % bundle["meta"]["rank"])
+    assert ranks == {0, 1}
+    # The collected tree reads straight into the diagnose tool.
+    diagnose = _tool("diagnose")
+    found = diagnose._expand([str(tmp_path / "collected")])
+    assert len(found) == 2

@@ -19,16 +19,25 @@ human reads at 3am:
   from the per-rank bundle sets one incident leaves behind.
 * **`--verbose`**: full stacks and span listings instead of tails.
 
+Directories expand recursively into per-rank subdirectories
+(`rank<R>/diag.rank<R>.<seq>.json` — the layout
+`telemetry.healthplane.DiagCollector` commits when rank 0 pulls the
+pod's bundles over the kvstore), so a rank-0 collected tree and a
+shared-filesystem bundle directory summarize and `--merge`
+interchangeably — mix them freely on one command line.
+
 Usage::
 
     python tools/diagnose.py DIAG_DIR
     python tools/diagnose.py --merge diag.rank0.000003.json diag.rank1.000002.json
+    python tools/diagnose.py --merge COLLECTED_DIR LOCAL_DIAG_DIR
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -38,18 +47,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from mxnet_tpu.telemetry.recorder import DIAG_RE  # noqa: E402
 
 
+_RANKDIR_RE = re.compile(r"^rank\d+$")
+
+
 def _expand(paths):
-    """Directories expand to their bundle files (sorted rank, seq);
-    explicit files pass through."""
+    """Directories expand to their bundle files (sorted rank, seq),
+    including one level of ``rank<R>/`` subdirectories — the
+    DiagCollector layout rank 0 commits pulled bundles into; explicit
+    files pass through."""
     out = []
     for path in paths:
         if os.path.isdir(path):
             found = []
             for name in os.listdir(path):
                 m = DIAG_RE.match(name)
+                sub = os.path.join(path, name)
                 if m:
-                    found.append((int(m.group(1)), int(m.group(2)),
-                                  os.path.join(path, name)))
+                    found.append((int(m.group(1)), int(m.group(2)), sub))
+                elif _RANKDIR_RE.match(name) and os.path.isdir(sub):
+                    for inner in os.listdir(sub):
+                        m = DIAG_RE.match(inner)
+                        if m:
+                            found.append((int(m.group(1)),
+                                          int(m.group(2)),
+                                          os.path.join(sub, inner)))
             out.extend(p for _, _, p in sorted(found))
         else:
             out.append(path)
