@@ -599,6 +599,137 @@ def _compile_accounting_rows():
               "s")
 
 
+def _compile_cache_child(cache_dir):
+    """One simulated process start with the persistent compile cache at
+    ``cache_dir`` (run twice by `_compile_cache_rows`: cold then warm).
+    Exercises all three cached compile sites the way a real restart
+    does — serving bucket-ladder warmup + first predict, fused-update
+    first step, TrainStep first step — and prints ONE JSON line:
+    time-to-first-batch per surface plus the per-site compile counts
+    this process actually paid (mx_compile_seconds is process-local, so
+    in a fresh child it IS this start's bill)."""
+    t_start = time.perf_counter()
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel import TrainStep
+    from mxnet_tpu.serving import InferenceServer
+    from mxnet_tpu.telemetry import memstats
+
+    assert os.environ.get("MXNET_COMPILE_CACHE") == cache_dir
+    rng = np.random.RandomState(0)
+
+    # Serving: a bucket ladder over a small MLP (the cached_op site).
+    w1 = rng.rand(64, 128).astype(np.float32)
+    b1 = rng.rand(128).astype(np.float32)
+    w2 = rng.rand(128, 10).astype(np.float32)
+
+    def fwd(w1_, b1_, w2_, x):
+        return nd.dot(nd.relu(nd.dot(x, w1_) + b1_), w2_)
+
+    server = InferenceServer(fwd, (w1, b1, w2), item_shape=(64,),
+                             max_batch=8)
+    server.predict(rng.rand(3, 64).astype(np.float32))
+    ttfb_serving = time.perf_counter() - t_start
+    server.shutdown()
+
+    # Fused update: one Trainer step (the fused_apply site). Stable
+    # prefix => stable param names => restart-stable executables.
+    net = nn.HybridSequential(prefix="ccbench_")
+    with net.name_scope():
+        for _ in range(3):
+            net.add(nn.Dense(128, activation="relu"))
+        net.add(nn.Dense(10))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    data = nd.array(rng.rand(8, 64).astype(np.float32))
+    from mxnet_tpu import autograd
+
+    with autograd.record():
+        loss = net(data).sum()
+    loss.backward()
+    trainer.step(8)
+
+    # Whole-step executable (the train_step site).
+    net2 = nn.Dense(10, in_units=32, prefix="ccbench_step_")
+    net2.initialize()
+    step = TrainStep(net2, gloss.L2Loss(), optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1})
+    loss = step(rng.rand(8, 32).astype(np.float32),
+                rng.rand(8, 10).astype(np.float32))
+    float(np.asarray(loss))             # force completion
+    ttfb_train = time.perf_counter() - t_start
+
+    counts = {site: rec["count"]
+              for site, rec in memstats.compile_stats().items()}
+    print(json.dumps({
+        "ttfb_serving_s": round(ttfb_serving, 3),
+        "ttfb_train_s": round(ttfb_train, 3),
+        "compile_counts": counts,
+    }), flush=True)
+    return 0
+
+
+def _compile_cache_rows():
+    """Compile-cache section (mxnet_tpu.compile, ISSUE 11): cold-vs-warm
+    restart measured honestly — two FRESH child processes sharing one
+    cache directory, each paying real imports, warmup and first batch.
+
+    THE CONTRACT ROW: warm_restart_compile_count == 0 — the second
+    start must load every executable (serving bucket ladder, fused
+    apply chunk, whole-step TrainStep) from the cache and compile
+    nothing at the cached sites. warm_restart_ttfb_seconds is the
+    payoff row (informative: wall time to first train batch of the
+    warm start, vs cold)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="mx_cc_bench_")
+    env = dict(os.environ, MXNET_COMPILE_CACHE=cache_dir)
+
+    def run_child():
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--compile-cache-child", cache_dir],
+            env=env, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError("compile-cache child failed:\n%s"
+                               % proc.stderr[-2000:])
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError("compile-cache child printed no JSON")
+
+    try:
+        cold = run_child()
+        warm = run_child()
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    sites = ("cached_op", "fused_apply", "train_step")
+    for site in sites:
+        _emit("compile_cache_cold_count[%s]" % site,
+              cold["compile_counts"].get(site, 0), "compiles")
+        _emit("compile_cache_warm_count[%s]" % site,
+              warm["compile_counts"].get(site, 0), "compiles")
+    _emit("cold_start_ttfb_seconds", cold["ttfb_train_s"], "s")
+    _emit("cold_start_serving_ttfb_seconds", cold["ttfb_serving_s"], "s")
+    # THE CONTRACT ROW: a warm restart compiles NOTHING at the cached
+    # sites — every executable deserializes from the persistent cache.
+    _emit("warm_restart_compile_count",
+          sum(warm["compile_counts"].get(site, 0) for site in sites),
+          "compiles")
+    _emit("warm_restart_ttfb_seconds", warm["ttfb_train_s"], "s")
+    _emit("warm_restart_serving_ttfb_seconds", warm["ttfb_serving_s"],
+          "s")
+
+
 def _load_rows(path):
     """Parse one bench output (JSON row per line; non-JSON lines — e.g.
     stderr interleave — are skipped) into {metric: row}."""
@@ -1023,9 +1154,16 @@ def main():
                         help="emit per-site compile count/seconds "
                              "deltas (B - A) from two bench outputs "
                              "and exit (no device needed)")
+    parser.add_argument("--compile-cache-child", metavar="CACHE_DIR",
+                        help="internal: run one simulated process start "
+                             "against CACHE_DIR and print its TTFB + "
+                             "compile counts (the compile_cache "
+                             "section's cold/warm worker)")
     args = parser.parse_args()
     if args.compare:
         return compare(args.compare[0], args.compare[1])
+    if args.compile_cache_child:
+        return _compile_cache_child(args.compile_cache_child)
 
     dev = _acquire_device()
     # Non-headline rows never take down the headline: a failed variant
@@ -1090,6 +1228,11 @@ def main():
         _checkpoint_rows()
     except Exception:
         print("bench checkpoint section failed:", file=sys.stderr)
+        traceback.print_exc()
+    try:
+        _compile_cache_rows()
+    except Exception:
+        print("bench compile_cache section failed:", file=sys.stderr)
         traceback.print_exc()
     # Measure the headline BEFORE the compile accounting so its fresh
     # TrainStep compile (the largest single compile of the run) is in

@@ -42,6 +42,7 @@ _LAZY = {
     "lr_scheduler": ".lr_scheduler",
     "callback": ".callback",
     "checkpoint": ".checkpoint",
+    "compile": ".compile",
     "data": ".data",
     "kvstore": ".kvstore",
     "kv": ".kvstore",

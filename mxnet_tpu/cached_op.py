@@ -108,6 +108,23 @@ class CachedOp:
             return out._data if isinstance(out, NDArray) else out
 
         self._op = Operator(name, pure, needs_rng=True, train_aware=True)
+        # Persistent compilation cache (mxnet_tpu.compile): when enabled,
+        # this op's per-signature executables build through the cached
+        # seam — a warm restart (or an elastic peer with a warm pod
+        # cache) traces but does NOT compile, and the wrapper does the
+        # compile accounting (only real XLA compiles count). The
+        # attrs/named key is restart-stable; the per-process op counter
+        # in `name` deliberately is NOT part of the cache key — the HLO
+        # fingerprint identifies the graph.
+        from . import compile as _cc
+
+        self._cc_active = _cc.enabled()
+        if self._cc_active:
+            self._op.jit_wrapper = lambda fn, key: _cc.cached_compile(
+                fn, "cached_op", key_parts=("cached_op", key))
+        # Off-ladder shape canonicalization (recompile elimination):
+        # set via pad_to_buckets().
+        self._pad_policy = None
 
     def __call__(self, *args, out=None):
         """Forward (reference: CachedOp::Forward via MXInvokeCachedOp).
@@ -130,12 +147,73 @@ class CachedOp:
             else:
                 raw = _reg.invoke_raw(self._op, arrays, attrs)
                 result = _wrap_outputs(raw, ctx, out=out)
-        if self.num_traces != traces_before:
+        if self.num_traces != traces_before and not self._cc_active:
             # This call filled the executable cache (new shape
             # signature): its wall time is trace + XLA compile — the
-            # compile-accounting seam (mx_compile_seconds).
+            # compile-accounting seam (mx_compile_seconds). Under the
+            # persistent cache the wrapper accounts real compiles
+            # itself — a trace satisfied from the cache is NOT a
+            # compile and must not pollute the warm-restart contract.
             _ms.observe_compile("cached_op", time.perf_counter() - t0)
         return result
+
+    def pad_to_buckets(self, policy):
+        """Canonicalize off-ladder batch shapes in :meth:`inference`
+        onto a bucket ladder (recompile elimination): a request of 5
+        rows pads to the 8-row bucket's executable and slices back,
+        instead of minting a 5-row trace + compile.
+
+        Contract — the serving contract: the graph must map each input
+        row to an output row independently (eval mode already turns
+        dropout off and pins BN to running stats, so per-row graphs
+        qualify). Outputs that REDUCE over the batch (a mean loss, a
+        batch sum) would silently include the padded zero rows, and an
+        output whose leading dim is not the batch but happens to equal
+        the bucket size would be wrongly sliced — don't enable padding
+        on such graphs.
+
+        ``policy``: a ``serving.BucketPolicy``, an explicit bucket list,
+        or a max-batch int (powers-of-two ladder). Returns self."""
+        from .serving.buckets import BucketPolicy
+
+        if policy is None:
+            self._pad_policy = None
+        elif isinstance(policy, BucketPolicy):
+            self._pad_policy = policy
+        elif isinstance(policy, (list, tuple)):
+            self._pad_policy = BucketPolicy(buckets=policy)
+        else:
+            self._pad_policy = BucketPolicy(max_batch=int(policy))
+        return self
+
+    def _canonical_rows(self, arrays):
+        """(bucket, rows) when inference should pad the leading batch
+        dim up the ladder, else None. Shapes above the ladder run
+        unpadded (their own signature) — canonicalization must never
+        reject work."""
+        if self._pad_policy is None:
+            return None
+        inputs = arrays[self._num_params:]
+        rows = next((int(a.shape[0]) for a in inputs
+                     if getattr(a, "ndim", 0) >= 1), None)
+        if rows is None or rows < 1 or rows > self._pad_policy.max_batch:
+            return None
+        bucket = self._pad_policy.bucket_for(rows)
+        return None if bucket == rows else (bucket, rows)
+
+    def _pad_inputs(self, arrays, bucket, rows):
+        """Zero-pad every batch-carrying input (leading dim == rows) up
+        to ``bucket``; params and batch-free inputs pass through."""
+        import jax.numpy as jnp
+
+        out = list(arrays)
+        for i in range(self._num_params, len(arrays)):
+            a = arrays[i]
+            if getattr(a, "ndim", 0) >= 1 and int(a.shape[0]) == rows:
+                pad = jnp.zeros((bucket - rows,) + tuple(a.shape[1:]),
+                                a.dtype)
+                out[i] = jnp.concatenate([a, pad])
+        return out
 
     def inference(self, *args, out=None):
         """Eval-mode forward that never records on the autograd tape and
@@ -145,16 +223,32 @@ class CachedOp:
         This is the serving hot path (mxnet_tpu/serving): the reference's
         ``bind(for_training=False)`` contract at CachedOp granularity.
         It shares the per-shape executable cache with eval-mode
-        ``__call__`` dispatches."""
+        ``__call__`` dispatches. With :meth:`pad_to_buckets` set,
+        off-ladder batch sizes canonicalize onto an existing bucket's
+        executable (pad up, slice back) instead of tracing anew."""
         arrays = [x._data if isinstance(x, NDArray) else x for x in args]
         ctx = next((x._ctx for x in args if isinstance(x, NDArray)), None)
 
         from .ops import registry as _reg
 
+        canon = self._canonical_rows(arrays)
+        if canon is not None:
+            bucket, rows = canon
+            arrays = self._pad_inputs(arrays, bucket, rows)
         traces_before = self.num_traces
         t0 = time.perf_counter()
         with _trace.span("cached_op::inference", op=self._op.name):
             raw = _reg.invoke_raw(self._op, arrays, {"training": False})
-        if self.num_traces != traces_before:
+        if self.num_traces != traces_before and not self._cc_active:
             _ms.observe_compile("cached_op", time.perf_counter() - t0)
+        if canon is not None:
+            # Slice the padded rows back out (batch-dim outputs only —
+            # a scalar/aggregate output is returned as computed).
+            if isinstance(raw, (list, tuple)):
+                raw = type(raw)(
+                    o[:rows] if getattr(o, "ndim", 0) >= 1
+                    and int(o.shape[0]) == bucket else o for o in raw)
+            elif getattr(raw, "ndim", 0) >= 1 and \
+                    int(raw.shape[0]) == bucket:
+                raw = raw[:rows]
         return _wrap_outputs(raw, ctx, out=out)

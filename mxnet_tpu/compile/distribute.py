@@ -1,0 +1,106 @@
+"""mxnet_tpu.compile.distribute — pod-wide compile-cache distribution
+over the kvstore command channel.
+
+The telemetry (``telemetry_push``/``telemetry_pull``) and forensics
+(``diag_*``) precedents established the pattern: a small command on the
+existing worker->server wire, server 0 as the rendezvous. This module
+rides three new commands:
+
+``cc_push(key, meta, blob)``
+    Publish one cache entry (pipelined ack, the push fast path). The
+    server keeps a bounded drop-oldest buffer of entries by total
+    bytes (``MXNET_PS_CC_BUFFER_MB``): the newest executables — the
+    ones an elastic joiner actually needs — survive.
+``cc_probe(keys)``
+    Which of ``keys`` the server currently holds (one round-trip for a
+    whole warmup's worth of lookups).
+``cc_pull(key)``
+    Fetch one entry: ``(meta, blob)`` or None. Entries are NOT drained
+    — unlike diag bundles they serve every later joiner.
+
+Role split (rank-0-compiles-peers-pull): by default only rank 0
+publishes (``publishes``) and every rank pulls on a local miss
+(``pulls``); both are constructor-overridable for asymmetric fleets
+(e.g. a dedicated compile rank). Oversized entries are never pushed
+(``MXNET_PS_CC_ENTRY_MB``) — a pathological megamodel executable must
+not evict the whole buffer.
+"""
+from __future__ import annotations
+
+from .. import env as _env
+from .. import log as _log
+from ..telemetry import metrics as _tm
+
+__all__ = ["CacheDistributor", "entry_bound_bytes"]
+
+_pushed_total = _tm.REGISTRY.counter(
+    "mx_compile_cache_pushed_total",
+    "Compile-cache entries published to the pod over the kvstore")
+_pulled_total = _tm.REGISTRY.counter(
+    "mx_compile_cache_pulled_total",
+    "Compile-cache entries fetched from the pod over the kvstore")
+
+_logger = _log.get_logger("mxnet_tpu.compile")
+
+
+def entry_bound_bytes():
+    """Largest entry the distributor ships (``MXNET_PS_CC_ENTRY_MB``)."""
+    return int(_env.get("MXNET_PS_CC_ENTRY_MB")) * (1 << 20)
+
+
+class CacheDistributor:
+    """Pod transport for compile-cache entries.
+
+    Parameters
+    ----------
+    kv : transport exposing ``rank`` and the ``cc_push``/``cc_pull``/
+        ``cc_probe`` commands (``KVStoreDist`` or a LocalBus endpoint).
+    publishes : whether this rank publishes entries it compiles
+        (default: rank 0 only).
+    pulls : whether this rank consults the pod on a local miss
+        (default: every rank — a probe is one small round-trip against
+        a multi-second compile).
+    max_entry_bytes : per-entry publish bound (default
+        ``MXNET_PS_CC_ENTRY_MB``).
+    """
+
+    def __init__(self, kv, publishes=None, pulls=True,
+                 max_entry_bytes=None):
+        self._kv = kv
+        self.rank = int(getattr(kv, "rank", 0))
+        self.publishes = (self.rank == 0) if publishes is None \
+            else bool(publishes)
+        self.pulls = bool(pulls)
+        self.max_entry_bytes = entry_bound_bytes() \
+            if max_entry_bytes is None else int(max_entry_bytes)
+
+    def publish(self, key, meta, payload):
+        """Push one entry to the pod rendezvous. Oversized entries are
+        skipped (warned, not raised). Returns True when shipped."""
+        if len(payload) > self.max_entry_bytes:
+            _log.warn_rate_limited(
+                _logger, "cc_dist_big:%d" % id(self), 300.0,
+                "compile-cache entry %s is %d bytes (> %d bound) — not "
+                "distributed; peers compile it locally", key,
+                len(payload), self.max_entry_bytes)
+            return False
+        self._kv.cc_push(key, meta, payload)
+        _pushed_total.inc()
+        return True
+
+    def probe(self, keys):
+        """Subset of ``keys`` the pod currently holds."""
+        return self._kv.cc_probe(list(keys))
+
+    def fetch(self, key):
+        """``(meta, payload)`` from the pod, or None. One probe first so
+        the common cold-pod miss costs a tiny round-trip, not a blob
+        transfer attempt."""
+        if not self._kv.cc_probe([key]):
+            return None
+        rec = self._kv.cc_pull(key)
+        if rec is None:
+            return None                 # raced a buffer eviction
+        _pulled_total.inc()
+        meta, payload = rec
+        return meta, payload

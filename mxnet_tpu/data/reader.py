@@ -96,12 +96,27 @@ class RecordDataset:
     ``idx_paths`` defaults to each rec's ``.idx`` sibling when it
     exists. Reads are stateless and thread-safe: the native core opens
     per-call, the python path keeps one handle per (thread, file).
+
+    A ``tools/rec_shard.py`` manifest opens directly: pass its
+    ``...-manifest.json`` path (alone) and the shard set it describes
+    becomes the sample space, with each shard's record count verified
+    against the manifest (a re-packed shard fails loudly instead of
+    silently serving a different sample space). See
+    :meth:`from_manifest` for the explicit spelling.
     """
 
-    def __init__(self, rec_paths, idx_paths=None):
+    def __init__(self, rec_paths, idx_paths=None, manifest_counts=None):
         if isinstance(rec_paths, (str, os.PathLike)):
             rec_paths = [rec_paths]
-        self.rec_paths = [os.fspath(p) for p in rec_paths]
+        rec_paths = [os.fspath(p) for p in rec_paths]
+        if len(rec_paths) == 1 and rec_paths[0].endswith(".json"):
+            if idx_paths is not None:
+                raise ValueError(
+                    "a manifest already names its shards' .idx files — "
+                    "don't pass idx_paths with a manifest")
+            rec_paths, idx_paths, manifest_counts = \
+                self._resolve_manifest(rec_paths[0])
+        self.rec_paths = rec_paths
         if not self.rec_paths:
             raise ValueError("no .rec files given")
         if idx_paths is None:
@@ -119,10 +134,51 @@ class RecordDataset:
         for rec, idx in zip(self.rec_paths, idx_paths):
             self._offsets.append(self._index_one(rec, idx))
         counts = [len(o) for o in self._offsets]
+        if manifest_counts is not None:
+            # Manifest fingerprint check: the shard set on disk must BE
+            # the split the manifest describes — per-shard record
+            # counts are the cheap invariant a re-pack cannot preserve
+            # by accident.
+            for rec, have, want in zip(self.rec_paths, counts,
+                                       manifest_counts):
+                if have != int(want):
+                    raise ValueError(
+                        "manifest mismatch for %s: indexed %d records, "
+                        "manifest says %d — the shard set changed since "
+                        "the split (re-run tools/rec_shard.py)"
+                        % (rec, have, want))
         self._cum = np.cumsum([0] + counts).tolist()
         self._tls = threading.local()
         if len(self) == 0:
             raise ValueError("no records in %s" % self.rec_paths)
+
+    @classmethod
+    def from_manifest(cls, manifest_path):
+        """Open the shard set a ``tools/rec_shard.py`` manifest
+        describes (paths resolved relative to the manifest file) with
+        per-shard record counts verified."""
+        return cls([os.fspath(manifest_path)])
+
+    @staticmethod
+    def _resolve_manifest(manifest_path):
+        """(rec_paths, idx_paths, counts) from a rec_shard manifest."""
+        import json
+
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        shards = manifest.get("shards")
+        if not isinstance(shards, list) or not shards:
+            raise ValueError(
+                "%s is not a rec_shard manifest (no 'shards' list)"
+                % manifest_path)
+        base = os.path.dirname(os.path.abspath(manifest_path))
+        recs, idxs, counts = [], [], []
+        for shard in shards:
+            recs.append(os.path.join(base, shard["rec"]))
+            idxs.append(os.path.join(base, shard["idx"])
+                        if shard.get("idx") else None)
+            counts.append(int(shard["records"]))
+        return recs, idxs, counts
 
     @staticmethod
     def _index_one(rec, idx):

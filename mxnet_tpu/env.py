@@ -51,6 +51,19 @@ CATALOGUE = [
          "(DDP-style; traffic scales with ceil(params/bucket))", False),
     Knob("MXNET_PROFILER_AUTOSTART", int, 0, "profiler.py",
          "start device+dispatch profiling at import", False),
+    Knob("MXNET_COMPILE_CACHE", str, "", "compile/",
+         "persistent compilation cache directory (empty = disabled): "
+         "warm restarts load executables instead of recompiling at the "
+         "cached_op / fused_apply / train_step seams", False),
+    Knob("MXNET_COMPILE_CACHE_MB", int, 2048, "compile/store.py",
+         "compile-cache retention budget; oldest-by-mtime entries are "
+         "retired past it (hits re-touch their entry)", False),
+    Knob("MXNET_PS_CC_ENTRY_MB", int, 64, "compile/distribute.py",
+         "largest compile-cache entry distributed over the kvstore; "
+         "bigger executables stay local-only", False),
+    Knob("MXNET_PS_CC_BUFFER_MB", int, 256, "kvstore_server.py",
+         "kvstore server's compile-cache buffer bound (total bytes, "
+         "drop-oldest)", False),
     Knob("DMLC_ROLE", str, "worker", "kvstore_server.py",
          "process role: worker | server | scheduler (set by "
          "tools/launch.py)", False),

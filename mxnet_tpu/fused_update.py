@@ -316,7 +316,7 @@ class _ApplyChunk:
 
     __slots__ = ("exec_fn", "flatten_fn", "shapes", "sizes", "offsets",
                  "n", "k", "flat_w", "flat_s", "weights", "wver",
-                 "views", "state_objs", "stale", "compiled")
+                 "views", "state_objs", "stale", "compiled", "cc")
 
     def __init__(self, exec_fn, flatten_fn, shapes, sizes, offsets, k):
         self.exec_fn = exec_fn
@@ -334,6 +334,7 @@ class _ApplyChunk:
         self.state_objs = []
         self.stale = True
         self.compiled = False      # first exec dispatch pays XLA compile
+        self.cc = False            # exec_fn rides the persistent cache
 
 
 class FusedApplier:
@@ -457,8 +458,22 @@ class FusedApplier:
             return parts[0] if len(parts) == 1 else \
                 jnp.concatenate(parts)
 
-        ch = _ApplyChunk(jax.jit(chunk_fn), jax.jit(flat_cat),
-                         tuple(shapes), sizes, offsets, k)
+        # Persistent compilation cache (mxnet_tpu.compile): the chunk
+        # executable is THE fused_apply compile site — under the cache a
+        # warm restart deserializes it instead of recompiling, and the
+        # wrapper does the compile accounting (ch.compiled timing below
+        # stays for the uncached path). The flatten executable rides the
+        # same seam uncounted (it was never part of mx_compile_seconds).
+        from . import compile as _cc
+
+        key = ("fused_apply", spec.name, repr(spec.statics), repr(sig))
+        ch = _ApplyChunk(
+            _cc.maybe_cached_jit(chunk_fn, "fused_apply", key_parts=key),
+            _cc.maybe_cached_jit(flat_cat, "fused_flatten",
+                                 key_parts=("fused_flatten", repr(sig)),
+                                 observe=False),
+            tuple(shapes), sizes, offsets, k)
+        ch.cc = isinstance(ch.exec_fn, _cc.CachedFunction)
         self._chunks[sig] = ch
         self.num_compiles += 1
         _apply_compiles.labels(optimizer=spec.name).inc()
@@ -534,7 +549,10 @@ class FusedApplier:
         # rescale is baked into the executable (see _build_chunk).
         lrs = jnp.asarray(np.asarray(lrs, wdt))
         wds = jnp.asarray(np.asarray(wds, wdt))
-        t_compile = None if ch.compiled else time.perf_counter()
+        # Under the persistent cache the CachedFunction accounts real
+        # compiles itself (a warm restart's first dispatch is a load,
+        # not a compile — it must not count).
+        t_compile = None if (ch.compiled or ch.cc) else time.perf_counter()
         outs, new_w, new_s = _dispatch(
             "trainer::fused_apply", ch.exec_fn,
             tuple(e[2]._data for e in group), ch.flat_w,

@@ -68,6 +68,12 @@ class Operator:
         self.aliases = tuple(aliases)
         self.needs_rng = needs_rng
         self.train_aware = train_aware
+        # Optional compile seam: when set (CachedOp under the persistent
+        # compilation cache), jitted() builds executables through
+        # `jit_wrapper(bound_fn, (attrs_key, named))` instead of a plain
+        # jax.jit — generic small ops never pay the wrapper's per-call
+        # signature hash; only whole-graph CachedOps opt in.
+        self.jit_wrapper = None
         self._jit_cache: dict = {}
         # attrs_key -> True when the trace under those attrs consumed no
         # randomness (set by CachedOp.pure). Such calls reuse one cached
@@ -96,9 +102,12 @@ class Operator:
         key = (attrs_key, named)
         hit = self._jit_cache.get(key)
         if hit is None:
-            import jax
+            if self.jit_wrapper is not None:
+                hit = self.jit_wrapper(self.bound_fn(attrs, named), key)
+            else:
+                import jax
 
-            hit = jax.jit(self.bound_fn(attrs, named))
+                hit = jax.jit(self.bound_fn(attrs, named))
             self._jit_cache[key] = hit
         return hit
 

@@ -17,7 +17,7 @@ Lifecycle::
     srv.shutdown()               # or use `with serving.InferenceServer(...)`
 """
 from .admission import AdmissionController, DeadlineExceededError, \
-    QueueFullError
+    QueueFullError, ServiceUnavailableError
 from .batcher import DynamicBatcher
 from .buckets import BucketPolicy
 from .engine import InferenceServer
@@ -25,4 +25,4 @@ from .metrics import ServingMetrics
 
 __all__ = ["InferenceServer", "BucketPolicy", "DynamicBatcher",
            "ServingMetrics", "AdmissionController", "QueueFullError",
-           "DeadlineExceededError"]
+           "DeadlineExceededError", "ServiceUnavailableError"]

@@ -8,12 +8,19 @@ gracefully: reject at the door once the queue is full
 shed queued requests whose deadline already passed (running the model
 for a caller that has given up wastes device time that live requests
 need).
+
+Readiness-aware admission closes the third gap: while the health
+plane's ``/readyz`` is false (a component still paying warmup compile),
+queueing a request only guarantees it blows its deadline behind the
+compile — shed it at the door instead (``ServiceUnavailableError``, the
+HTTP-503 semantics a load balancer retries elsewhere).
 """
 from __future__ import annotations
 
 import time
 
-__all__ = ["QueueFullError", "DeadlineExceededError", "AdmissionController"]
+__all__ = ["QueueFullError", "DeadlineExceededError",
+           "ServiceUnavailableError", "AdmissionController"]
 
 
 class QueueFullError(RuntimeError):
@@ -22,6 +29,12 @@ class QueueFullError(RuntimeError):
 
 class DeadlineExceededError(RuntimeError):
     """Set on a request's future when it expired before executing."""
+
+
+class ServiceUnavailableError(RuntimeError):
+    """Raised by submit() while the process is not ready (``/readyz``
+    false — warmup compile still in flight): the 503 shed, so callers
+    retry another replica instead of queueing behind the compile."""
 
 
 class AdmissionController:
@@ -34,16 +47,28 @@ class AdmissionController:
     default_timeout_ms : float, optional
         Deadline applied to requests that pass no explicit timeout.
         None means such requests never expire in the queue.
+    readiness : callable() -> bool, optional
+        Readiness gate consulted on every admit (pass
+        ``telemetry.healthplane.is_ready`` to mirror ``/readyz``).
+        While it returns False new requests are shed with
+        :class:`ServiceUnavailableError` instead of queued.
     """
 
-    def __init__(self, max_queue=128, default_timeout_ms=None):
+    def __init__(self, max_queue=128, default_timeout_ms=None,
+                 readiness=None):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1, got %r" % (max_queue,))
         self.max_queue = max_queue
         self.default_timeout_ms = default_timeout_ms
+        self.readiness = readiness
 
     def admit(self, queue_len):
-        """Raise QueueFullError when a new request must be rejected."""
+        """Raise ServiceUnavailableError while the readiness gate is
+        down, QueueFullError when a new request must be rejected."""
+        if self.readiness is not None and not self.readiness():
+            raise ServiceUnavailableError(
+                "not ready (/readyz false): warmup still in flight — "
+                "retry another replica")
         if queue_len >= self.max_queue:
             raise QueueFullError(
                 "serving queue full (%d pending, max_queue=%d)"

@@ -115,12 +115,19 @@ class InferenceServer:
         with DeadlineExceededError.
     warmup : precompile every bucket at construction (default True).
     start : start the worker thread at construction (default True).
+    shed_unready : bool
+        Readiness-aware admission (default False): while the health
+        plane's ``/readyz`` is false — ANY registered component still
+        warming, this server included — ``submit()`` sheds with
+        ``ServiceUnavailableError`` (503 semantics) instead of queueing
+        requests that would only blow their deadlines behind a warmup
+        compile.
     """
 
     def __init__(self, fn=None, params=(), *, item_shape, dtype="float32",
                  max_batch=32, buckets=None, max_delay_ms=5.0,
                  max_queue=128, timeout_ms=None, ctx=None, metrics=None,
-                 model=None, warmup=True, start=True):
+                 model=None, warmup=True, start=True, shed_unready=False):
         if (fn is None) == (model is None):
             raise ValueError("pass exactly one of fn= or model=")
         self._model = model if model is not None else _FnModel(fn, params)
@@ -145,7 +152,9 @@ class InferenceServer:
         self._batcher = DynamicBatcher(
             self._run_batch, self.policy,
             AdmissionController(max_queue=max_queue,
-                                default_timeout_ms=timeout_ms),
+                                default_timeout_ms=timeout_ms,
+                                readiness=_hp.is_ready if shed_unready
+                                else None),
             self.metrics, max_delay_ms=max_delay_ms)
         if warmup:
             self.warmup()

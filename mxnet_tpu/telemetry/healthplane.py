@@ -272,6 +272,13 @@ class DiagCollector:
         committed atomically to ``<directory>/rank<R>/<name>`` (the
         layout ``tools/diagnose.py`` expands). Required on rank 0.
     interval_s : ``tick()`` cadence.
+    keep_last : retention — newest bundles kept PER RANK directory
+        (None = unbounded). The checkpoint ``keep_last`` semantics: GC
+        runs after every successful collect, newest survive.
+    max_bytes : retention — total byte budget across the whole
+        collected tree (None = unbounded); past it, oldest-by-mtime
+        bundles are retired regardless of rank. Both bounds compose
+        (keep_last first, then the byte cap).
     clock : injectable monotonic clock for tests.
 
     ``tick()`` from the step loop (or ``start()`` a daemon thread) does
@@ -284,13 +291,15 @@ class DiagCollector:
     """
 
     def __init__(self, kv, recorder, directory=None, interval_s=5.0,
-                 clock=time.monotonic):
+                 keep_last=None, max_bytes=None, clock=time.monotonic):
         self._kv = kv
         self._recorder = recorder
         self.rank = int(getattr(kv, "rank", 0))
         self.directory = directory
         if self.rank == 0 and directory is None:
             raise ValueError("rank 0 needs directory= to collect into")
+        self.keep_last = None if keep_last is None else int(keep_last)
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
         self.interval_s = float(interval_s)
         self._clock = clock
         self._last = None
@@ -352,7 +361,74 @@ class DiagCollector:
                 written.append(path)
                 _collected_total.labels(rank=str(rank)).inc()
         self.collected.extend(written)
+        if written and (self.keep_last is not None
+                        or self.max_bytes is not None):
+            self.gc()
         return written
+
+    def gc(self):
+        """Retention over the collected tree (rank 0): per-rank
+        ``keep_last`` newest bundles (names carry a zero-padded seq, so
+        lexical order IS capture order — a restart-reset seq falls back
+        to mtime like checkpoint GC's torn-step handling), then the
+        ``max_bytes`` budget oldest-by-mtime across ranks. Unlinks are
+        best-effort: a vanished file is already collected state, not an
+        error. Returns the paths removed."""
+        if self.rank != 0 or self.directory is None:
+            return []
+        removed = []
+        survivors = []
+        try:
+            rank_dirs = sorted(
+                d for d in os.listdir(self.directory)
+                if d.startswith("rank") and
+                os.path.isdir(os.path.join(self.directory, d)))
+        except OSError:
+            return []
+        for rd in rank_dirs:
+            rank_dir = os.path.join(self.directory, rd)
+            try:
+                names = sorted(n for n in os.listdir(rank_dir)
+                               if n.startswith("diag."))
+            except OSError:
+                continue
+            if self.keep_last is None:
+                drop = []
+            elif self.keep_last <= 0:
+                # keep_last=0 keeps NOTHING (names[:-0] would keep
+                # everything — the del q[:-0] bug class).
+                drop = list(names)
+            else:
+                drop = names[:-self.keep_last]
+            for name in drop:
+                path = os.path.join(rank_dir, name)
+                try:
+                    os.remove(path)
+                    removed.append(path)
+                except OSError:
+                    pass
+            for name in names[len(drop):]:
+                survivors.append(os.path.join(rank_dir, name))
+        if self.max_bytes is not None:
+            stats = []
+            for path in survivors:
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                stats.append((st.st_mtime, st.st_size, path))
+            stats.sort()
+            total = sum(s[1] for s in stats)
+            for _, size, path in stats:
+                if total <= self.max_bytes:
+                    break
+                try:
+                    os.remove(path)
+                except OSError:
+                    continue
+                total -= size
+                removed.append(path)
+        return removed
 
     def request_pod_bundle(self, kind="pod_snapshot", msg=""):
         """Fan out an on-demand capture to EVERY rank (rank 0's live

@@ -1,0 +1,110 @@
+"""Worker program for the 2-process compile-cache acceptance test
+(tests/test_compile_cache.py, launched via tools/launch.py roles).
+
+Proves the ISSUE 11 distribution property over a REAL dist kvstore:
+rank 0 compiles the shared executables (a CachedOp bucket ladder, a
+fused-update chunk, a whole-step TrainStep) with the persistent cache
+enabled and publishes every entry over ``cc_push``; rank 1 starts with
+an EMPTY local cache directory, builds the same workload after a
+barrier, and performs ZERO local compiles at the shared sites — every
+executable arrives over ``cc_probe``/``cc_pull`` (and is committed to
+rank 1's own disk, so its NEXT restart doesn't even need the pod).
+"""
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import numpy as np                                      # noqa: E402
+
+import mxnet_tpu as mx                                  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd               # noqa: E402
+from mxnet_tpu import compile as cc                     # noqa: E402
+from mxnet_tpu.cached_op import CachedOp                # noqa: E402
+from mxnet_tpu.gluon import nn                          # noqa: E402
+from mxnet_tpu.gluon import loss as gloss               # noqa: E402
+from mxnet_tpu.parallel import TrainStep                # noqa: E402
+from mxnet_tpu.telemetry import memstats                # noqa: E402
+from mxnet_tpu.telemetry import metrics as tmetrics     # noqa: E402
+
+SITES = ("cached_op", "fused_apply", "train_step")
+
+
+def build_workload(rng):
+    """The shared executables: identical graphs on both ranks (fixed
+    prefixes => restart/rank-stable param names => identical HLO)."""
+    # CachedOp bucket ladder (the serving warmup shape).
+    w = nd.array(rng.rand(16, 8).astype(np.float32))
+
+    def fwd(w_, x):
+        return nd.dot(x, w_)
+
+    op = CachedOp(fwd, num_params=1)
+    for rows in (1, 2, 4):
+        op.inference(w, nd.array(rng.rand(rows, 16).astype(np.float32)))
+
+    # Fused-update chunk.
+    net = nn.Dense(8, in_units=16, prefix="ccprog_")
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    with autograd.record():
+        loss = net(nd.array(rng.rand(4, 16).astype(np.float32))).sum()
+    loss.backward()
+    trainer.step(4)
+
+    # Whole-step TrainStep executable.
+    net2 = nn.Dense(4, in_units=8, prefix="ccprog_step_")
+    net2.initialize()
+    step = TrainStep(net2, gloss.L2Loss(), optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1})
+    out = step(rng.rand(4, 8).astype(np.float32),
+               rng.rand(4, 4).astype(np.float32))
+    float(np.asarray(out))
+
+
+def main():
+    out_dir = sys.argv[1]
+    kv = mx.kvstore.create("dist_sync")
+    rank = kv.rank
+
+    # Private per-rank cache directory — rank 1's starts EMPTY and
+    # nothing below may read a peer's disk.
+    local_dir = os.path.join(out_dir, "cache_rank%d" % rank)
+    cc.configure(local_dir)
+    cc.attach_kvstore(kv)
+
+    rng = np.random.RandomState(7)      # identical shapes on both ranks
+    if rank == 0:
+        build_workload(rng)
+        kv.barrier()                    # entries pushed + acked first
+    else:
+        kv.barrier()                    # wait for rank 0's publishes
+        build_workload(rng)
+
+    counts = {site: rec["count"]
+              for site, rec in memstats.compile_stats().items()}
+    hits = {}
+    reg = tmetrics.REGISTRY.get("mx_compile_cache_hits_total")
+    for (site, source), child in reg.collect():
+        hits["%s/%s" % (site, source)] = child.value
+    result = {
+        "rank": rank,
+        "compile_counts": counts,
+        "hits": hits,
+        "local_entries": sorted(os.listdir(local_dir))
+        if os.path.isdir(local_dir) else [],
+    }
+    with open(os.path.join(out_dir, "result_rank%d.json" % rank),
+              "w") as f:
+        json.dump(result, f)
+
+    kv.barrier()
+    kv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

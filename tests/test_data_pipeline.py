@@ -481,3 +481,53 @@ def test_two_rank_kill_resume_stream_bit_identical(tmp_path):
         assert golden.count("\n") == batches
         assert resumed == golden, \
             "rank %d stream diverged after resume" % r
+
+
+# -- rec_shard manifest consumption (ISSUE 11 satellite) ----------------------
+
+def _split_manifest(tmp_path, n_records=10, n_shards=3):
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    import rec_shard
+
+    rec = _pack(tmp_path, "manifested", n_records)
+    out_prefix = os.path.join(str(tmp_path), "shards", "manifested")
+    rec_shard.split(rec, n_shards, out_prefix)
+    return out_prefix + "-manifest.json"
+
+
+def test_record_dataset_opens_rec_shard_manifest(tmp_path):
+    """RecordDataset accepts a tools/rec_shard.py manifest directly:
+    open the manifest, get the whole shard set as one sample space."""
+    mpath = _split_manifest(tmp_path)
+    ds = data.RecordDataset.from_manifest(mpath)
+    assert len(ds) == 10
+    payloads = {recordio.unpack(ds.read(i))[1] for i in range(len(ds))}
+    assert payloads == {str(i).encode() for i in range(10)}
+    # The bare-path spelling works too (a lone .json rec_path).
+    assert len(data.RecordDataset(mpath)) == 10
+    # A manifest names its own idx files; extra idx_paths are an error.
+    with pytest.raises(ValueError):
+        data.RecordDataset([mpath], idx_paths=["x.idx"])
+
+
+def test_record_dataset_manifest_fingerprint_check(tmp_path):
+    """A shard set that changed since the split fails loudly — the
+    manifest's per-shard record counts are the fingerprint."""
+    import json as _json
+
+    mpath = _split_manifest(tmp_path)
+    with open(mpath) as f:
+        manifest = _json.load(f)
+    manifest["shards"][1]["records"] += 1
+    with open(mpath, "w") as f:
+        _json.dump(manifest, f)
+    with pytest.raises(ValueError, match="manifest mismatch"):
+        data.RecordDataset.from_manifest(mpath)
+
+
+def test_record_dataset_rejects_non_manifest_json(tmp_path):
+    bogus = os.path.join(str(tmp_path), "not_manifest.json")
+    with open(bogus, "w") as f:
+        f.write("{}")
+    with pytest.raises(ValueError, match="shards"):
+        data.RecordDataset(bogus)

@@ -662,3 +662,56 @@ def test_two_process_pod_snapshot_and_fleet_slo(tmp_path):
     diagnose = _tool("diagnose")
     found = diagnose._expand([str(tmp_path / "collected")])
     assert len(found) == 2
+
+
+# -- collected-tree retention (ISSUE 11 satellite) ----------------------------
+
+def _collector_with_retention(tmp_path, **kw):
+    bus = aggregate.LocalBus(num_workers=2)
+    recs, cols = [], []
+    for rank in (0, 1):
+        rec = telemetry.FlightRecorder(
+            str(tmp_path / ("local%d" % rank)), rank=rank,
+            rate_limit_s=0.0)
+        recs.append(rec)
+        cols.append(hp.DiagCollector(
+            bus.endpoint(rank), rec, interval_s=0.0,
+            directory=str(tmp_path / "collected") if rank == 0 else None,
+            **(kw if rank == 0 else {})))
+    return recs, cols
+
+
+def test_diag_collector_keep_last_per_rank(tmp_path):
+    """keep_last retention mirrors checkpoint GC: after every collect,
+    only the newest N bundles survive in each rank<R>/ directory."""
+    recs, (c0, c1) = _collector_with_retention(tmp_path, keep_last=2)
+    for i in range(5):
+        recs[0].capture("probe", "r0 #%d" % i)
+        recs[1].capture("probe", "r1 #%d" % i)
+        c1.step()
+        c0.step()
+    root = tmp_path / "collected"
+    for rank in (0, 1):
+        names = sorted(os.listdir(str(root / ("rank%d" % rank))))
+        assert len(names) == 2, names
+        # The newest sequence numbers survived (zero-padded names sort).
+        assert names[-1].endswith("%06d.json" % 5)
+
+
+def test_diag_collector_bytes_cap_across_ranks(tmp_path):
+    """The max_bytes budget bounds the WHOLE collected tree,
+    oldest-by-mtime first regardless of rank."""
+    recs, (c0, c1) = _collector_with_retention(tmp_path, max_bytes=1)
+    recs[0].capture("probe", "r0")
+    recs[1].capture("probe", "r1")
+    c1.step()
+    c0.step()
+    root = tmp_path / "collected"
+    total = sum(
+        os.path.getsize(os.path.join(str(root), rd, n))
+        for rd in os.listdir(str(root))
+        for n in os.listdir(os.path.join(str(root), rd)))
+    # A 1-byte budget can keep nothing: every bundle was retired.
+    assert total == 0
+    # The collector still records what it collected (audit trail).
+    assert len(c0.collected) == 2

@@ -402,3 +402,71 @@ def test_worker_threads_are_daemonized():
                    for t in threading.enumerate())
     finally:
         srv.shutdown()
+
+
+# -- readiness-aware admission (ISSUE 11 satellite) ---------------------------
+
+def test_shed_unready_503_until_warm():
+    """With shed_unready=True, submits are shed with
+    ServiceUnavailableError (the 503 semantics) while /readyz is false
+    — queueing them would only blow their deadlines behind the warmup
+    compile — and admit normally once every component is ready."""
+    from mxnet_tpu.serving import ServiceUnavailableError
+    from mxnet_tpu.telemetry import healthplane as hp
+
+    hp.reset()
+    try:
+        srv = _server(warmup=False, start=False, shed_unready=True)
+        try:
+            assert not hp.is_ready()        # the server's own slot
+            with pytest.raises(ServiceUnavailableError):
+                srv.submit(np.ones((1, 4), np.float32))
+            srv.warmup()                    # ladder warm -> ready
+            assert hp.is_ready()
+            srv.start()
+            out = srv.predict(np.ones((2, 4), np.float32))
+            assert out.shape == (2, 3)
+        finally:
+            srv.shutdown()
+    finally:
+        hp.reset()
+
+
+def test_shed_unready_sees_other_components_too():
+    """The gate mirrors /readyz: ANY warming component (a TrainStep
+    mid-compile, a DataPipeline before first batch) sheds serving
+    traffic, not just the server's own warmup."""
+    from mxnet_tpu.serving import ServiceUnavailableError
+    from mxnet_tpu.telemetry import healthplane as hp
+
+    hp.reset()
+    try:
+        srv = _server(warmup=True, start=True, shed_unready=True)
+        try:
+            ghost = hp.unique_component("train_step")   # still warming
+            with pytest.raises(ServiceUnavailableError):
+                srv.submit(np.ones((1, 4), np.float32))
+            hp.set_ready(ghost)
+            assert srv.predict(
+                np.ones((1, 4), np.float32)).shape == (1, 3)
+        finally:
+            srv.shutdown()
+    finally:
+        hp.reset()
+
+
+def test_default_admission_ignores_readiness():
+    """shed_unready defaults OFF: existing deployments queue through
+    warmup exactly as before."""
+    from mxnet_tpu.telemetry import healthplane as hp
+
+    hp.reset()
+    try:
+        srv = _server(warmup=False, start=True)
+        try:
+            out = srv.predict(np.ones((1, 4), np.float32))
+            assert out.shape == (1, 3)
+        finally:
+            srv.shutdown()
+    finally:
+        hp.reset()
