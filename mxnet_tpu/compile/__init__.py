@@ -304,6 +304,7 @@ class CachedFunction:
         self.num_compiles += 1
         if self._observe:
             _ms.observe_compile(self.site, dt)
+        _record_cost(self.site, key, compiled)
         if store is not None:
             self._commit(store, key, compiled, dt)
         return compiled
@@ -333,6 +334,7 @@ class CachedFunction:
             return None
         self.num_hits += 1
         _hits_total.labels(site=self.site, source=source).inc()
+        _record_cost(self.site, key, compiled)
         return compiled
 
     def _try_remote(self, store, key):
@@ -407,6 +409,19 @@ class CachedFunction:
                     _logger, "cc_pub:%d" % id(self), 60.0,
                     "compile cache publish failed at site %s (peers "
                     "will compile locally): %s", self.site, exc)
+
+
+def _record_cost(site, key, compiled):
+    """Report the executable's cost_analysis() flops/bytes to the
+    attribution plane (mx_executable_flops{site}) — achieved-FLOPs
+    accounting. Advisory: a backend/deserialized executable without
+    cost analysis records nothing."""
+    try:
+        from ..telemetry import attribution as _attr
+
+        _attr.record_executable_cost(site, compiled, key=key)
+    except Exception:
+        pass
 
 
 # -- serialization backend -----------------------------------------------------

@@ -24,7 +24,7 @@ from .sharding import epoch_order, shard_indices, shard_slice, num_padded
 __all__ = ["epoch_order", "shard_indices", "shard_slice", "num_padded",
            "RecordDataset", "ShardedRecordStream", "DecodePool",
            "DevicePrefetcher", "DataPipeline", "ImageRecordDecoder",
-           "stall_fraction"]
+           "stall_fraction", "DecodeAutoscaler"]
 
 _LAZY = {
     "RecordDataset": ".reader",
@@ -34,11 +34,13 @@ _LAZY = {
     "DataPipeline": ".pipeline",
     "ImageRecordDecoder": ".pipeline",
     "stall_fraction": ".pipeline",
+    "DecodeAutoscaler": ".autoscale",
     "reader": ".reader",
     "decode": ".decode",
     "prefetch": ".prefetch",
     "pipeline": ".pipeline",
     "sharding": ".sharding",
+    "autoscale": ".autoscale",
 }
 
 

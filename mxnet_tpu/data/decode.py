@@ -127,6 +127,28 @@ class DecodePool:
                 raise payload
             yield payload
 
+    def resize(self, num_threads):
+        """Retarget the worker-team size (the autoscaler's lever;
+        ``data.autoscale.DecodeAutoscaler`` drives this off the
+        data-wait share of step time). Growing takes effect on the next
+        submit — ThreadPoolExecutor spawns lazily up to its bound.
+        Shrinking is best-effort: the executor cannot retire threads,
+        so surplus workers go idle while the in-flight window
+        (``2 * num_threads``, re-derived here) stops feeding them —
+        concurrency follows the window even where thread count cannot.
+        Returns the effective size."""
+        n = max(1, int(num_threads))
+        with self._lock:
+            if self._closed or n == self.num_threads:
+                return self.num_threads
+            self.num_threads = n
+            self.inflight = 2 * n
+            pool = self._pool
+        # Same-package reach into the executor's bound: submit() calls
+        # _adjust_thread_count itself, so raising the bound is enough.
+        pool._max_workers = n
+        return n
+
     def close(self):
         """Shut the worker team down (idempotent) and release the
         workers' watchdog lanes — a long-lived process cycling pipelines

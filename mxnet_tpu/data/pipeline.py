@@ -124,6 +124,12 @@ class DataPipeline:
     place : False -> host numpy batches; True (default) -> async
         ``jax.device_put``; callable -> custom placement
         (e.g. ``jax.make_array_from_process_local_data`` for SPMD).
+    autoscale : False (default) -> fixed decode_threads; True or a
+        kwargs dict -> a :class:`~mxnet_tpu.data.autoscale.\
+DecodeAutoscaler` resizes the decode pool off the data-wait share of
+        step time (hysteresis thresholds / bounds in the dict;
+        ``MXNET_DATA_MAX_WORKERS`` caps growth), ticked once per
+        delivered batch.
 
     Epoch geometry: every epoch delivers exactly
     ``batches_per_epoch = ceil(samples_per_shard / batch_size)``
@@ -134,7 +140,8 @@ class DataPipeline:
 
     def __init__(self, dataset, decode_fn, batch_size, shuffle=True,
                  seed=0, num_shards=None, shard_index=None,
-                 decode_threads=4, ordered=True, prefetch=2, place=True):
+                 decode_threads=4, ordered=True, prefetch=2, place=True,
+                 autoscale=False):
         from .sharding import resolve_shards
 
         if not isinstance(dataset, RecordDataset):
@@ -156,6 +163,8 @@ class DataPipeline:
                        else place if callable(place) else None)
         if self.batch_size < 1:
             raise ValueError("batch_size must be >= 1")
+        self._autoscale = autoscale
+        self._autoscaler = None
         self._pool = None
         self._prefetcher = None
         self._batches = None
@@ -317,6 +326,7 @@ class DataPipeline:
         if self._pool is not None:
             self._pool.close()
             self._pool = None
+        self._autoscaler = None     # the pool it resized is gone
         self._batches = None
 
     # -- iteration ------------------------------------------------------------
@@ -363,6 +373,14 @@ class DataPipeline:
         if not self._hp_ready:      # first delivered batch: primed
             self._hp_ready = True
             _hp.set_ready(self._hp_component)
+        if self._autoscale and self._pool is not None:
+            if self._autoscaler is None:
+                from .autoscale import DecodeAutoscaler
+
+                kwargs = self._autoscale \
+                    if isinstance(self._autoscale, dict) else {}
+                self._autoscaler = DecodeAutoscaler(self._pool, **kwargs)
+            self._autoscaler.tick()
         return out
 
     next = __next__

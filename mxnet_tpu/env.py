@@ -51,6 +51,18 @@ CATALOGUE = [
          "(DDP-style; traffic scales with ceil(params/bucket))", False),
     Knob("MXNET_PROFILER_AUTOSTART", int, 0, "profiler.py",
          "start device+dispatch profiling at import", False),
+    Knob("MXNET_PROFILE_HZ", float, 67.0, "telemetry/profiling.py",
+         "continuous-profiler stack sampling rate (Hz); non-round so "
+         "loops don't alias with the sampler", False),
+    Knob("MXNET_PROFILE_WINDOW_S", float, 30.0, "telemetry/profiling.py",
+         "continuous-profiler window length; each window closes one "
+         "collapsed-stack profile into the retention ring", False),
+    Knob("MXNET_PROFILE_RETAIN", int, 20, "telemetry/profiling.py",
+         "profile windows retained (ring; /debug/pprof?seconds=N can "
+         "reach back window_s * retain seconds)", False),
+    Knob("MXNET_DATA_MAX_WORKERS", int, 16, "data/autoscale.py",
+         "decode-pool autoscaling ceiling: DecodeAutoscaler never grows "
+         "a pool past this many workers", False),
     Knob("MXNET_COMPILE_CACHE", str, "", "compile/",
          "persistent compilation cache directory (empty = disabled): "
          "warm restarts load executables instead of recompiling at the "

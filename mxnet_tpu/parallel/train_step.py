@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random
+from ..telemetry import attribution as _attr
 from ..telemetry import healthplane as _hp
 from ..telemetry import memstats as _ms
 from ..telemetry import metrics as _tm
@@ -647,6 +648,13 @@ class TrainStep:
                 new_p, new_s, new_a, loss = self._jitted(
                     self._param_vals, self._opt_state, self._aux_vals,
                     x, y, jnp.float32(self.lr), jnp.float32(t), key)
+            if _attr.device_spans_enabled():
+                # Step attribution's device bracket: how long the
+                # device still chews after dispatch returned. Gated —
+                # the block_until_ready makes every step host-
+                # synchronous, which only an attributor should buy.
+                with _trace.span("train_step::device", step=t):
+                    jax.block_until_ready(loss)
             # Single-bytecode commit of everything a checkpoint reads: a
             # signal handler (checkpoint.PreemptionHook) can interrupt
             # between any two statements here, and snapshotting params

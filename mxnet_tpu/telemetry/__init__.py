@@ -92,6 +92,26 @@ The fleet health plane (ISSUE 8) makes the pod operable from outside:
 * Fleet SLOs — ``Aggregator.fleet_slo(...)`` scopes a
   :class:`.slo.ServiceLevelObjective` to the merged ``rank="all"``
   histograms so ONE rank-0 ``BurnRateMonitor`` alerts for the pod.
+
+Continuous profiling & step attribution (ISSUE 12) answer "where does
+wall-clock go" on a HEALTHY pod:
+
+* :mod:`.profiling` — :class:`ContinuousProfiler`: an always-on
+  ~67 Hz stack sampler folding every thread into windowed
+  collapsed-stack profiles (lane-tagged roots, file:line frame keys,
+  retention ring, ≤1% self-accounted overhead) with a
+  rolling-baseline ``profile_regression`` sentinel; pulled via
+  ``GET /debug/pprof``, flight-recorder ``profile`` sections, or
+  pod-wide over the kvstore diag channel.
+* :mod:`.attribution` — :class:`StepAttribution`:
+  ``mx_step_phase_seconds{phase}`` per-step decomposition (data_wait /
+  h2d / dispatch / device_compute / allreduce / checkpoint / other),
+  the one-hot ``mx_step_bound{cause}`` classifier + ``input_bound``
+  anomaly, and ``mx_executable_flops{site}`` from ``cost_analysis()``
+  at the compile seam (achieved-FLOPs accounting).
+* :mod:`.remote_write` — the Prometheus remote-write wire format
+  (pure-python protobuf ``WriteRequest`` + snappy framing) as
+  ``PushExporter(wire_format="remote_write")``.
 """
 from __future__ import annotations
 
@@ -106,6 +126,9 @@ from . import watchdog
 from . import recorder
 from . import numerics
 from . import healthplane
+from . import profiling
+from . import attribution
+from . import remote_write
 from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
                       render_prometheus, start_http_server,
                       default_buckets, set_exemplars)
@@ -118,17 +141,21 @@ from .watchdog import HangWatchdog
 from .numerics import NumericGuard, NonFiniteError
 from .memstats import DeviceMemoryMonitor
 from .healthplane import HealthPlane, DiagCollector
+from .profiling import ContinuousProfiler
+from .attribution import StepAttribution
 
 __all__ = ["metrics", "trace", "aggregate", "export", "flamegraph",
            "slo", "memstats", "watchdog", "recorder", "numerics",
-           "healthplane", "Registry", "REGISTRY", "counter", "gauge",
+           "healthplane", "profiling", "attribution", "remote_write",
+           "Registry", "REGISTRY", "counter", "gauge",
            "histogram", "render_prometheus", "start_http_server",
            "default_buckets", "set_exemplars", "StepMonitor",
            "Aggregator", "LocalBus", "StreamingTraceWriter",
            "PushExporter", "BurnRateMonitor", "ServiceLevelObjective",
            "FlightRecorder", "HangWatchdog", "NumericGuard",
            "NonFiniteError", "DeviceMemoryMonitor", "HealthPlane",
-           "DiagCollector", "set_enabled", "enabled"]
+           "DiagCollector", "ContinuousProfiler", "StepAttribution",
+           "set_enabled", "enabled"]
 
 
 def set_enabled(on):

@@ -272,16 +272,19 @@ _push_buffered = _metrics.REGISTRY.gauge(
     "Rendered snapshots awaiting (re)delivery to the push gateway")
 
 
-def _http_post(url, body):
-    """Default PushExporter transport: one stdlib POST of the classic
-    Prometheus text exposition (the push-gateway wire format). Raises
-    on any network error or HTTP >= 400."""
+_TEXT_HEADERS = {"Content-Type":
+                 "text/plain; version=0.0.4; charset=utf-8"}
+
+
+def _http_post(url, body, headers=None):
+    """Default PushExporter transport: one stdlib POST (classic text
+    exposition headers unless the caller supplies remote-write ones).
+    Raises on any network error or HTTP >= 400."""
     import urllib.request
 
     req = urllib.request.Request(
         url, data=body, method="POST",
-        headers={"Content-Type":
-                 "text/plain; version=0.0.4; charset=utf-8"})
+        headers=dict(headers or _TEXT_HEADERS))
     with urllib.request.urlopen(req, timeout=10) as resp:
         status = getattr(resp, "status", 200)
         if status >= 400:       # some transports don't raise on 4xx/5xx
@@ -298,11 +301,23 @@ class PushExporter:
     ----------
     url : push-gateway base, e.g. ``http://gateway:9091``. The snapshot
         is POSTed to ``<url>/metrics/job/<job>[/instance/<instance>]``
-        (pass a full path containing ``/metrics/`` to override).
+        (pass a full path containing ``/metrics/`` to override). With
+        ``wire_format="remote_write"`` the url is used VERBATIM — pass
+        the receiver's write endpoint, e.g.
+        ``http://mimir:9009/api/v1/push`` or
+        ``http://prom:9090/api/v1/write``.
     registry : what to render — a ``Registry`` or an ``Aggregator``
         (rank 0 passes its aggregator so ONE push describes the whole
         pod). Default: the process-wide registry.
-    job, instance : push-gateway grouping labels in the URL path.
+    job, instance : push-gateway grouping labels in the URL path —
+        or, under remote write, labels stamped onto every series.
+    wire_format : ``"text"`` (default — the classic push-gateway
+        exposition) or ``"remote_write"`` — a snappy-compressed
+        protobuf ``WriteRequest`` (:mod:`..remote_write`; Prometheus /
+        Mimir / Thanos Receive / VictoriaMetrics ingest this). A
+        remote-write render failure degrades to ONE classic-text
+        snapshot, counted on ``mx_export_failures_total`` — the
+        cadence survives an encoding edge case.
     interval_s : snapshot cadence for ``tick()``/``start()``.
     max_buffer : bounded retry buffer of rendered snapshots. While the
         gateway is down, snapshots queue here oldest-first;
@@ -325,14 +340,32 @@ class PushExporter:
 
     def __init__(self, url, registry=None, job="mxnet_tpu", instance=None,
                  interval_s=15.0, max_buffer=8, backoff_s=1.0,
-                 max_backoff_s=300.0, transport=None, clock=time.monotonic):
-        self.url = self._target(url, job, instance)
+                 max_backoff_s=300.0, transport=None, wire_format="text",
+                 clock=time.monotonic):
+        if wire_format not in ("text", "remote_write"):
+            raise ValueError("wire_format must be 'text' or "
+                             "'remote_write' (got %r)" % (wire_format,))
+        self.wire_format = wire_format
+        if wire_format == "remote_write":
+            self.url = url          # the receiver's write endpoint
+            self._extra_labels = {"job": job}
+            if instance is not None:
+                self._extra_labels["instance"] = instance
+        else:
+            self.url = self._target(url, job, instance)
+            self._extra_labels = None
         self._registry = registry
         self.interval_s = float(interval_s)
         self.max_buffer = int(max_buffer)
         self.backoff_s = float(backoff_s)
         self.max_backoff_s = float(max_backoff_s)
-        self._transport = transport if transport is not None else _http_post
+        # Injected transports keep the 2-arg (url, body) surface;
+        # per-snapshot headers (text vs remote-write, and the fallback
+        # from one to the other) ride the buffer to the default POST.
+        if transport is not None:
+            self._send = lambda url, body, headers: transport(url, body)
+        else:
+            self._send = _http_post
         self._clock = clock
         self._lock = threading.Lock()       # buffer/backoff state only
         self._send_lock = threading.Lock()  # serializes deliveries
@@ -353,10 +386,38 @@ class PushExporter:
         return url.rstrip("/") + path
 
     def _render(self):
+        """One snapshot as ``(body, headers)`` in the configured wire
+        format. A remote-write encoding failure (a duck registry
+        without the snapshot surface, an exotic value) degrades to the
+        classic text format for THIS snapshot, counted as a failure —
+        delivery cadence over format purity."""
         from . import metrics as _m
 
         reg = self._registry or _m.REGISTRY
-        return reg.render_prometheus().encode("utf-8")
+        if self.wire_format == "remote_write":
+            from . import remote_write as _rw
+
+            try:
+                source = reg
+                if not hasattr(source, "collect"):
+                    # Aggregator duck: render its merged fleet view
+                    # when present, else its local source registry.
+                    source = getattr(reg, "fleet", None) \
+                        or getattr(reg, "_registry", None) \
+                        or _m.REGISTRY
+                body = _rw.encode_write_request(
+                    source, int(time.time() * 1e3),
+                    extra_labels=self._extra_labels)
+                return body, dict(_rw.CONTENT_HEADERS)
+            except Exception as exc:
+                _push_failures.inc()
+                _log.warn_rate_limited(
+                    _log.get_logger("mxnet_tpu.telemetry"),
+                    "push_export:rw:%d" % id(self), 30.0,
+                    "remote-write encoding failed (falling back to the "
+                    "classic text format for this snapshot): %s", exc)
+        return reg.render_prometheus().encode("utf-8"), \
+            dict(_TEXT_HEADERS)
 
     @property
     def pending(self):
@@ -390,7 +451,7 @@ class PushExporter:
                         return True
                     head = self._buffer[0]
                 try:
-                    self._transport(self.url, head)
+                    self._send(self.url, head[0], head[1])
                 except Exception as exc:
                     with self._lock:
                         _push_failures.inc()

@@ -26,11 +26,14 @@ sampled view.
 """
 from __future__ import annotations
 
+import os
+import re
+
 from . import metrics as _metrics
 from . import trace as _trace
 
 __all__ = ["top", "render_top", "collapsed", "dump_collapsed",
-           "diff_top", "render_diff"]
+           "diff_top", "render_diff", "frame_label", "render_collapsed"]
 
 # Clock-granularity slack when deciding whether one span nests inside
 # another (µs; perf_counter is ns-resolution but float µs rounding can
@@ -86,6 +89,29 @@ def render_top(k=20, registry=None):
     return "\n".join(lines)
 
 
+def frame_label(func, filename, lineno):
+    """Collapsed-stack frame key for one code location:
+    ``func (file.py:123)``. Folding by function name ALONE merges every
+    same-named method into one frame — a process full of ``run`` loops
+    (decode workers, the prefetcher, the checkpoint writer) collapses
+    into a single meaningless ``run`` tower — so the frame key carries
+    the defining file:line. The location uses the file's basename:
+    stable across checkouts/venv paths, unique enough with the line
+    number, short enough to read on a flame."""
+    return "%s (%s:%d)" % (func, os.path.basename(str(filename)),
+                           int(lineno))
+
+
+def render_collapsed(folded):
+    """``{stack_path: self_us}`` -> collapsed-stack text (one
+    ``path self_us`` line per stack, integer µs, zero-weight stacks
+    dropped) — the exact format :func:`collapsed` emits, shared with
+    the continuous profiler's windows."""
+    return "\n".join("%s %d" % (path, round(us))
+                     for path, us in sorted(folded.items())
+                     if round(us) > 0) + ("\n" if folded else "")
+
+
 def _track_stacks(events, root, folded):
     """Fold one thread track's complete events into ``folded``
     ({stack_path: self_time_us})."""
@@ -134,9 +160,7 @@ def collapsed(trace_data=None):
     for key, track in sorted(tracks.items()):
         root = names.get(key, "tid-%s" % (key[1],))
         _track_stacks(track, root, folded)
-    return "\n".join("%s %d" % (path, round(us))
-                     for path, us in sorted(folded.items())
-                     if round(us) > 0) + ("\n" if folded else "")
+    return render_collapsed(folded)
 
 
 def dump_collapsed(path, trace_data=None):
@@ -172,13 +196,32 @@ def _parse_collapsed(capture):
     return folded
 
 
-def _by_leaf(folded):
+# Frame-location suffix frame_label appends ("func (file.py:123)"):
+# stripped for cross-era diffs against captures folded before locations
+# existed.
+_LOC_RE = re.compile(r" \([^();]+:\d+\)$")
+
+
+def _strip_loc(name):
+    return _LOC_RE.sub("", name)
+
+
+def _has_loc(leaf):
+    return any(_LOC_RE.search(name) for name in leaf)
+
+
+def _by_leaf(folded, strip_loc=False):
     """Fold full stacks down to leaf-frame self time (the op/span that
     actually burned the cycles, regardless of which thread or caller it
-    ran under — two captures rarely share exact thread/stack shapes)."""
+    ran under — two captures rarely share exact thread/stack shapes).
+    ``strip_loc`` drops the ``(file:line)`` frame-key suffix — the
+    compatibility fold for diffing a located capture against one from
+    before frame keys carried locations."""
     leaf = {}
     for path, us in folded.items():
         name = path.rsplit(";", 1)[-1]
+        if strip_loc:
+            name = _strip_loc(name)
         leaf[name] = leaf.get(name, 0.0) + us
     return leaf
 
@@ -193,9 +236,20 @@ def diff_top(before, after, k=20, min_share=0.001):
     ``{op, before_us, after_us, before_share, after_share, delta_pp}``
     (``delta_pp`` = after minus before share, in percentage points;
     positive = regressed). Ops below ``min_share`` in BOTH captures are
-    noise and dropped."""
-    b_leaf = _by_leaf(_parse_collapsed(before))
-    a_leaf = _by_leaf(_parse_collapsed(after))
+    noise and dropped.
+
+    Frame keys may carry ``(file:line)`` locations (sampler captures,
+    :func:`frame_label`) or not (span captures, pre-location files).
+    When exactly ONE side carries locations the diff folds both to bare
+    names — an old capture stays diffable against a new one instead of
+    every frame reading as a 100% add/remove pair."""
+    b_folded = _parse_collapsed(before)
+    a_folded = _parse_collapsed(after)
+    b_leaf = _by_leaf(b_folded)
+    a_leaf = _by_leaf(a_folded)
+    if _has_loc(b_leaf) != _has_loc(a_leaf):
+        b_leaf = _by_leaf(b_folded, strip_loc=True)
+        a_leaf = _by_leaf(a_folded, strip_loc=True)
     b_total = sum(b_leaf.values()) or 1.0
     a_total = sum(a_leaf.values()) or 1.0
     rows = []

@@ -29,6 +29,12 @@ module closes that loop with three pieces:
   ``GET /debug/watchdog``      lane states + effective deadlines
   ``GET /debug/pipeline``      watched DataPipelines' ``debug_state()``
   ``GET /debug/memory``        device memory + compile accounting
+  ``GET /debug/pprof``         the continuous profiler's collapsed-stack
+                               capture (``?seconds=N`` merges windows,
+                               ``&format=collapsed|json``; text/plain by
+                               default — pipe straight into flamegraph.pl)
+  ``GET /debug/attribution``   step-phase decomposition, bound cause and
+                               per-site executable flops
   ``POST /debug/bundle``       trigger a local flight-recorder bundle NOW
   ===========================  =============================================
 
@@ -144,13 +150,20 @@ class HealthPlane:
         (404 without one).
     pipelines : DataPipelines whose ``debug_state()`` feeds
         ``/debug/pipeline`` (``watch_pipeline`` adds more).
+    profiler : ContinuousProfiler, optional — backs ``/debug/pprof``
+        (default: the process's active profiler; 404 when none runs).
+    attribution : StepAttribution, optional — backs
+        ``/debug/attribution`` (404 without one).
     """
 
-    def __init__(self, watchdog=None, recorder=None, pipelines=()):
+    def __init__(self, watchdog=None, recorder=None, pipelines=(),
+                 profiler=None, attribution=None):
         self._watchdog = watchdog if watchdog is not None \
             else _watchdog.HangWatchdog()
         self._recorder = recorder
         self._pipelines = list(pipelines)
+        self._profiler = profiler
+        self._attribution = attribution
 
     def watch_pipeline(self, pipeline):
         """Include a pipeline's ``debug_state()`` in ``/debug/pipeline``
@@ -217,12 +230,39 @@ class HealthPlane:
             return None
         return self._recorder.capture(kind, msg)
 
+    def pprof(self, seconds=None, format="collapsed"):
+        """The ``/debug/pprof`` body: ``(status, body, content_type)``.
+        ``format="collapsed"`` (default) returns the folded-stack text
+        every flamegraph tool eats; ``"json"`` the profiler's
+        ``debug_state`` (window metadata + capture)."""
+        from . import profiling as _profiling
+
+        profiler = self._profiler if self._profiler is not None \
+            else _profiling.active_profiler()
+        if profiler is None:
+            return 404, {"error": "no ContinuousProfiler running "
+                                  "(start telemetry.ContinuousProfiler)"}
+        if format == "json":
+            return 200, profiler.debug_state(seconds=seconds)
+        return (200, profiler.collapsed(seconds=seconds),
+                "text/plain; charset=utf-8")
+
+    def attribution_state(self):
+        if self._attribution is None:
+            return 404, {"error": "no StepAttribution attached"}
+        return 200, self._attribution.snapshot()
+
     # -- HTTP routing (used by metrics.start_http_server) ---------------------
 
     def handle(self, method, path):
-        """Route one request: returns ``(status, json_body)`` or None
-        for paths this plane does not own (the server falls through to
-        ``/metrics`` handling)."""
+        """Route one request: returns ``(status, json_body)`` — or
+        ``(status, raw_body, content_type)`` for non-JSON responses —
+        or None for paths this plane does not own (the server falls
+        through to ``/metrics`` handling). ``path`` may carry a query
+        string (``/debug/pprof?seconds=60``)."""
+        from urllib.parse import parse_qs
+
+        path, _, query = path.partition("?")
         if method == "GET":
             if path == "/healthz":
                 ok, body = self.healthz()
@@ -238,6 +278,20 @@ class HealthPlane:
                 return 200, self.pipeline_state()
             if path == "/debug/memory":
                 return 200, self.memory()
+            if path == "/debug/pprof":
+                params = parse_qs(query)
+                try:
+                    seconds = float(params["seconds"][0]) \
+                        if "seconds" in params else None
+                except ValueError:
+                    return 400, {"error": "seconds must be a number"}
+                fmt = params.get("format", ["collapsed"])[0]
+                if fmt not in ("collapsed", "json"):
+                    return 400, {"error": "format must be collapsed "
+                                          "or json"}
+                return self.pprof(seconds=seconds, format=fmt)
+            if path == "/debug/attribution":
+                return self.attribution_state()
         elif method == "POST" and path == "/debug/bundle":
             if self._recorder is None:
                 return 404, {"error": "no FlightRecorder attached"}
@@ -268,6 +322,12 @@ class DiagCollector:
         ``KVStoreDist`` or a ``LocalBus`` endpoint.
     recorder : this rank's FlightRecorder (bundle source, and the
         rate limiter pod-snapshot requests run through).
+    profiler : ContinuousProfiler, optional (default: the process's
+        active one at capture time) — :meth:`request_pod_profile`
+        fan-outs make every rank push its collapsed capture
+        (``profile.rank<R>.<seq>.collapsed``) over the same channel,
+        so rank 0 assembles one merged pod profile with no shared
+        filesystem.
     directory : rank 0's collected-bundle root; each pulled bundle is
         committed atomically to ``<directory>/rank<R>/<name>`` (the
         layout ``tools/diagnose.py`` expands). Required on rank 0.
@@ -291,9 +351,11 @@ class DiagCollector:
     """
 
     def __init__(self, kv, recorder, directory=None, interval_s=5.0,
-                 keep_last=None, max_bytes=None, clock=time.monotonic):
+                 keep_last=None, max_bytes=None, profiler=None,
+                 clock=time.monotonic):
         self._kv = kv
         self._recorder = recorder
+        self._profiler = profiler
         self.rank = int(getattr(kv, "rank", 0))
         self.directory = directory
         if self.rank == 0 and directory is None:
@@ -315,15 +377,39 @@ class DiagCollector:
     # -- the three duties -----------------------------------------------------
 
     def poll_request(self):
-        """Answer an outstanding pod-snapshot request: capture one
-        bundle through the recorder's rate limiter (suppressed repeats
-        are counted, exactly like anomaly triggers). Returns the bundle
-        path when one was captured."""
+        """Answer an outstanding pod-wide request. Bundle requests
+        capture through the recorder's rate limiter (suppressed repeats
+        are counted, exactly like anomaly triggers) and the bundle
+        rides the normal :meth:`push_new` path; ``pod_profile``
+        requests push this rank's collapsed profiler capture directly
+        (``profile.rank<R>.<seq>.collapsed``, stacks re-rooted under
+        ``rank<R>`` so the merged pod profile keeps one lane per rank).
+        Returns the bundle path / pushed profile name when one was
+        produced."""
         seq, kind, msg = self._kv.diag_request_check()
         if seq <= self._handled_seq:
             return None
         self._handled_seq = seq
+        if kind == "pod_profile":
+            return self._push_profile(seq, msg)
         return self._recorder.request(kind or "pod_snapshot", msg or "")
+
+    def _push_profile(self, seq, msg):
+        from . import profiling as _profiling
+
+        profiler = self._profiler if self._profiler is not None \
+            else _profiling.active_profiler()
+        if profiler is None:
+            return None         # nothing to contribute; not an error
+        try:
+            seconds = float(msg) if msg else None
+        except ValueError:
+            seconds = None
+        capture = _profiling.prefix_collapsed(
+            profiler.collapsed(seconds=seconds), "rank%d" % self.rank)
+        name = "profile.rank%d.%06d.collapsed" % (self.rank, seq)
+        self._kv.diag_push(name, capture.encode("utf-8"))
+        return name
 
     def push_new(self):
         """Ship bundles committed since the last push to server 0.
@@ -387,28 +473,32 @@ class DiagCollector:
             return []
         for rd in rank_dirs:
             rank_dir = os.path.join(self.directory, rd)
-            try:
-                names = sorted(n for n in os.listdir(rank_dir)
-                               if n.startswith("diag."))
-            except OSError:
-                continue
-            if self.keep_last is None:
-                drop = []
-            elif self.keep_last <= 0:
-                # keep_last=0 keeps NOTHING (names[:-0] would keep
-                # everything — the del q[:-0] bug class).
-                drop = list(names)
-            else:
-                drop = names[:-self.keep_last]
-            for name in drop:
-                path = os.path.join(rank_dir, name)
+            # keep_last applies PER KIND (diag bundles vs profile
+            # captures) so a burst of profile pulls cannot evict the
+            # incident's diag bundles, and vice versa.
+            for prefix in ("diag.", "profile."):
                 try:
-                    os.remove(path)
-                    removed.append(path)
+                    names = sorted(n for n in os.listdir(rank_dir)
+                                   if n.startswith(prefix))
                 except OSError:
-                    pass
-            for name in names[len(drop):]:
-                survivors.append(os.path.join(rank_dir, name))
+                    break
+                if self.keep_last is None:
+                    drop = []
+                elif self.keep_last <= 0:
+                    # keep_last=0 keeps NOTHING (names[:-0] would keep
+                    # everything — the del q[:-0] bug class).
+                    drop = list(names)
+                else:
+                    drop = names[:-self.keep_last]
+                for name in drop:
+                    path = os.path.join(rank_dir, name)
+                    try:
+                        os.remove(path)
+                        removed.append(path)
+                    except OSError:
+                        pass
+                for name in names[len(drop):]:
+                    survivors.append(os.path.join(rank_dir, name))
         if self.max_bytes is not None:
             stats = []
             for path in survivors:
@@ -436,6 +526,51 @@ class DiagCollector:
         rank's next ``tick()``/:meth:`poll_request` captures and pushes.
         Returns the request sequence number."""
         return self._kv.diag_request(kind, msg)
+
+    def request_pod_profile(self, seconds=None):
+        """Fan out a profile capture to EVERY rank: each rank's next
+        ``tick()`` pushes its continuous profiler's last ``seconds`` of
+        collapsed stacks; rank 0 collects them into
+        ``<dir>/rank<R>/profile.*.collapsed`` — one "what is the whole
+        pod doing" flamegraph, no shared filesystem. Returns the
+        request sequence number."""
+        msg = "" if seconds is None else repr(float(seconds))
+        return self._kv.diag_request("pod_profile", msg)
+
+    def merged_pod_profile(self):
+        """Rank 0: merge every collected ``profile.*.collapsed`` into
+        one collapsed-stack text (stacks already carry ``rank<R>``
+        roots). Empty string when nothing is collected yet."""
+        from . import profiling as _profiling
+
+        if self.rank != 0 or self.directory is None:
+            return ""
+        captures = []
+        try:
+            rank_dirs = sorted(os.listdir(self.directory))
+        except OSError:
+            return ""
+        for rd in rank_dirs:
+            rank_dir = os.path.join(self.directory, rd)
+            if not os.path.isdir(rank_dir):
+                continue
+            try:
+                names = sorted(n for n in os.listdir(rank_dir)
+                               if n.startswith("profile."))
+            except OSError:
+                continue
+            for name in names:
+                try:
+                    with open(os.path.join(rank_dir, name)) as f:
+                        captures.append(f.read())
+                except OSError:
+                    continue
+        if not captures:
+            return ""
+        from . import flamegraph as _flamegraph
+
+        return _flamegraph.render_collapsed(
+            _profiling.merge_collapsed(captures))
 
     # -- cadence --------------------------------------------------------------
 

@@ -658,17 +658,26 @@ def start_http_server(port=0, addr="127.0.0.1", registry=None,
             if health is None:
                 return False
             try:
-                routed = health.handle(method,
-                                       self.path.split("?", 1)[0])
+                # The FULL path, query string included — /debug/pprof
+                # takes ?seconds=N&format=...; the plane strips the
+                # query for routes that ignore it.
+                routed = health.handle(method, self.path)
             except Exception as exc:    # a probe must never hang/close
                 routed = (500, {"error": repr(exc)})
             if routed is None:
                 return False
-            status, obj = routed
-            body = _json.dumps(obj, default=str).encode("utf-8")
+            if len(routed) == 3:
+                # (status, body, content_type): a raw non-JSON body —
+                # /debug/pprof's text/plain collapsed capture.
+                status, body, ctype = routed
+                if isinstance(body, str):
+                    body = body.encode("utf-8")
+            else:
+                status, obj = routed
+                body = _json.dumps(obj, default=str).encode("utf-8")
+                ctype = "application/json; charset=utf-8"
             self.send_response(status)
-            self.send_header("Content-Type",
-                             "application/json; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -33,6 +33,10 @@ Bundle contents (the black-box recorder set):
   accounting (:mod:`..memstats`);
 * ``watchdog`` — heartbeat-lane states (which lane was in flight, for
   how long, on which thread);
+* ``profile`` — the continuous profiler's latest collapsed-stack
+  window (when a :class:`~mxnet_tpu.telemetry.profiling.\
+ContinuousProfiler` is active): what every thread was *actually* doing
+  in the minutes before the anomaly, spans or not;
 * ``env`` — knob catalogue values, MXNET_*/DMLC_*/JAX_*/XLA_* environ,
   python/jax versions, argv, uptime.
 
@@ -300,6 +304,7 @@ class FlightRecorder:
             "data": [self._safe("pipeline", self._pipeline_state(p))
                      for p in self._pipelines],
             "watchdog": self._safe("watchdog", self._watchdog_state),
+            "profile": self._safe("profile", self._profile_state),
             "device_memory": self._safe("device_memory",
                                         self._memory_state),
             "compile": self._safe("compile", self._compile_state),
@@ -330,6 +335,12 @@ class FlightRecorder:
         from . import watchdog as _watchdog
 
         return _watchdog.lane_snapshot()
+
+    @staticmethod
+    def _profile_state():
+        from . import profiling as _profiling
+
+        return _profiling.bundle_state()
 
     @staticmethod
     def _memory_state():

@@ -1,0 +1,89 @@
+"""Worker program for the 2-process pod-profile acceptance test
+(tests/test_profiling.py, launched via tools/launch.py roles).
+
+Proves the ISSUE 12 pod-profile property over a REAL dist kvstore:
+each rank runs its own ContinuousProfiler (private retention ring, no
+shared filesystem); rank 0's ``request_pod_profile`` fan-out makes
+every rank push its collapsed capture over the kvstore diag channel;
+rank 0 collects one ``profile.rank<R>.*.collapsed`` per rank and merges
+them into one pod profile whose stacks keep per-rank roots.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import mxnet_tpu as mx                                  # noqa: E402
+from mxnet_tpu import telemetry                         # noqa: E402
+from mxnet_tpu.telemetry import healthplane as hp       # noqa: E402
+
+
+def rank_marker_0():
+    """Rank 0's distinctive busy frame (shows up in its stacks)."""
+    time.sleep(0.002)
+
+
+def rank_marker_1():
+    """Rank 1's distinctive busy frame."""
+    time.sleep(0.002)
+
+
+def main():
+    out_dir = sys.argv[1]
+    kv = mx.kvstore.create("dist_sync")
+    rank = kv.rank
+    marker = rank_marker_0 if rank == 0 else rank_marker_1
+
+    # A worker thread with a rank-distinct frame for the profiler to
+    # catch; sampled manually for determinism (no Hz-timing races).
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            marker()
+
+    worker = threading.Thread(target=busy, name="pod_busy", daemon=True)
+    worker.start()
+
+    profiler = telemetry.ContinuousProfiler(hz=200.0, window_s=3600.0,
+                                            retain=4)
+    for _ in range(50):
+        profiler.sample()
+    profiler.rotate()
+
+    recorder = telemetry.FlightRecorder(
+        os.path.join(out_dir, "local_rank%d" % rank), rank=rank,
+        rate_limit_s=0.0)
+    collector = hp.DiagCollector(
+        kv, recorder, interval_s=0.0, profiler=profiler,
+        directory=os.path.join(out_dir, "collected") if rank == 0
+        else None)
+
+    if rank == 0:
+        collector.request_pod_profile(seconds=3600.0)
+    kv._barrier()                   # request posted before anyone polls
+    pushed = collector.poll_request()
+    assert pushed, "rank %d pushed no profile" % rank
+    collector.push_new()            # (no bundles; keeps parity w/ step)
+    kv._barrier()                   # all pushes processed server-side
+    if rank == 0:
+        collector.collect()
+        merged = collector.merged_pod_profile()
+        with open(os.path.join(out_dir, "result.json"), "w") as f:
+            f.write(json.dumps({
+                "collected": sorted(collector.collected),
+                "merged": merged,
+            }))
+    kv._barrier()
+    stop.set()
+    profiler.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Work with continuous-profiler captures: top / diff / merge.
+
+`mxnet_tpu.telemetry.profiling.ContinuousProfiler` (and the
+`/debug/pprof` endpoint, and pod-profile collection) produce
+collapsed-stack captures — ``root;frame;frame <self_us>`` lines, the
+format every flamegraph tool eats. This CLI gives the three operations
+an operator reaches for without leaving the terminal:
+
+* ``top``    — rank leaf frames by self time (pprof -top for a capture)
+* ``diff``   — self-time **share** regressions between two captures
+               (`flamegraph.diff_top`; same view as tools/flame_diff.py,
+               here for sampler captures)
+* ``merge``  — fold several captures (windows, ranks) into one
+
+Usage::
+
+    python tools/profile_tool.py top capture.collapsed [-k 30]
+    python tools/profile_tool.py diff before.collapsed after.collapsed
+    python tools/profile_tool.py merge -o pod.collapsed rank0.collapsed rank1.collapsed
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()
+
+
+def cmd_top(args):
+    from mxnet_tpu.telemetry import flamegraph
+
+    folded = flamegraph._parse_collapsed(_read(args.capture))
+    leaf = flamegraph._by_leaf(folded)
+    total = sum(leaf.values()) or 1.0
+    rows = sorted(leaf.items(), key=lambda kv: kv[1], reverse=True)
+    print("Top %d frames by self time (%s)"
+          % (args.k, os.path.basename(args.capture)))
+    print("%-64s %12s %7s" % ("Frame", "Self(ms)", "Share"))
+    for name, us in rows[:args.k]:
+        print("%-64s %12.3f %6.1f%%" % (name, us / 1e3,
+                                        us / total * 100.0))
+    if not rows:
+        print("(empty capture)")
+    return 0
+
+
+def cmd_diff(args):
+    from mxnet_tpu.telemetry import flamegraph
+
+    print(flamegraph.render_diff(_read(args.before), _read(args.after),
+                                 k=args.k, min_share=args.min_share))
+    return 0
+
+
+def cmd_merge(args):
+    from mxnet_tpu.telemetry import flamegraph, profiling
+
+    folded = profiling.merge_collapsed([_read(p) for p in args.captures])
+    text = flamegraph.render_collapsed(folded)
+    if args.output:
+        from mxnet_tpu.telemetry import export
+
+        export.commit_bytes(args.output, text.encode("utf-8"))
+        print("merged %d captures (%d stacks) -> %s"
+              % (len(args.captures), len(folded), args.output))
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="top/diff/merge over collapsed profiler captures.")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_top = sub.add_parser("top", help="rank leaf frames by self time")
+    p_top.add_argument("capture")
+    p_top.add_argument("-k", type=int, default=20)
+    p_top.set_defaults(fn=cmd_top)
+
+    p_diff = sub.add_parser("diff",
+                            help="self-time share diff of two captures")
+    p_diff.add_argument("before")
+    p_diff.add_argument("after")
+    p_diff.add_argument("-k", type=int, default=20)
+    p_diff.add_argument("--min-share", type=float, default=0.001)
+    p_diff.set_defaults(fn=cmd_diff)
+
+    p_merge = sub.add_parser("merge",
+                             help="fold several captures into one")
+    p_merge.add_argument("captures", nargs="+")
+    p_merge.add_argument("-o", "--output",
+                         help="write merged capture here (atomic "
+                              "commit); default stdout")
+    p_merge.set_defaults(fn=cmd_merge)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
