@@ -877,6 +877,16 @@ def compare(a_path, b_path):
     import re as _re
 
     a, b = _load_rows(a_path), _load_rows(b_path)
+    # Perf-contract deltas first: the step-hot-path rows two runs are
+    # most often compared on (overlap efficiency, fused speedup).
+    for metric, unit in (("fused_overlap_efficiency", "share"),
+                         ("trainer_fused_update_speedup", "x")):
+        if metric in a or metric in b:
+            va = float(a.get(metric, {}).get("value", 0) or 0)
+            vb = float(b.get(metric, {}).get("value", 0) or 0)
+            print(json.dumps({"metric": metric + "_delta",
+                              "value": round(vb - va, 4), "unit": unit,
+                              "a": va, "b": vb}), flush=True)
     row_re = _re.compile(r"^compile_(count|seconds)\[(.+)\]$")
     sites = {}
     for metric in list(a) + list(b):
@@ -1088,9 +1098,114 @@ def _trainer_rows():
         if n == 1000:
             speedup_1000 = loop_ms / fused_ms
     # THE CONTRACT ROW: at 1000 params the coalesced apply must beat the
-    # per-param loop by >= 2x (it is typically far more — the loop pays
-    # 1000 dispatches, the fused path pays 1).
+    # per-param loop by >= 2x — the enforced floor; the target since the
+    # overlap work (ISSUE 13) is >= 3x, which this box typically
+    # measures (the loop pays 1000 dispatches, the fused path pays 1).
     _emit("trainer_fused_update_speedup", round(speedup_1000, 2), "x")
+
+
+def _trainer_overlap_rows():
+    """Comm/compute overlap section (ISSUE 13): the fused step's
+    pipelined reduce->apply (bucket i applies while bucket i+1 is
+    still reducing). THE CONTRACT ROW: fused_overlap_efficiency >= 0.30
+    — at the default-shaped workload at least 30% of total reduce time
+    must be hidden behind the apply stream.
+
+    CPU-backend honesty (the trainer-section discipline): this box has
+    no DCN, so the transport is a latency-injecting local store (a
+    sleep per push/pull leg standing in for the worker->server
+    round-trip), and the compute that hides it is the HOST side of the
+    apply stream (unflatten + fused dispatch + per-param commit). On a
+    real pod the same pipeline additionally hides transport behind
+    device compute, so this measurement *understates* the win. The
+    efficiency is computed from the runtime's own accounting
+    (mx_trainer_reduce_{seconds,hidden_seconds}_total deltas), i.e. the
+    number an operator would scrape — and the serial (depth=0) row on
+    the identical workload pins the no-overlap baseline near 0."""
+    import time as _t
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.telemetry import metrics as tm
+
+    lat = 0.0012                  # one simulated DCN round-trip (s)
+
+    class LatencyStore(kvs.KVStoreLocal):
+        """Local store + synthetic wire latency per push/pull leg."""
+
+        @property
+        def type(self):
+            # "dist" in the name makes the Trainer treat this like a
+            # real multi-process store (kvstore engaged on 1 context).
+            return "dist_bench_latency"
+
+        def push(self, key, value, priority=0):
+            _t.sleep(lat / 2)
+            super().push(key, value, priority)
+
+        def pull(self, key, out=None, priority=0, ignore_sparse=True):
+            _t.sleep(lat / 2)
+            super().pull(key, out=out, priority=priority,
+                         ignore_sparse=ignore_sparse)
+
+    saved = {k: os.environ.get(k) for k in
+             ("MXNET_FUSED_OVERLAP_DEPTH", "MXNET_FUSED_BUCKET_MB")}
+
+    def run(depth, steps=6, n=800, size=1024, clip=None):
+        os.environ["MXNET_FUSED_OVERLAP_DEPTH"] = str(depth)
+        os.environ["MXNET_FUSED_BUCKET_MB"] = "1"   # ~4 buckets
+        rng = np.random.RandomState(5)
+        params = []
+        for k in range(n):
+            p = gluon.Parameter("ov_bench_%d_%d" % (depth, k),
+                                shape=(size,))
+            p.initialize(init=mx.init.Constant(0.0))
+            p.set_data(nd.array(rng.randn(size).astype(np.float32)))
+            params.append(p)
+        trainer = gluon.Trainer(
+            params, "sgd", {"learning_rate": 0.05, "momentum": 0.9},
+            kvstore=LatencyStore(device_mode=True),
+            update_on_kvstore=False, global_norm_clip=clip)
+        for p in params:
+            p.grad()[:] = rng.randn(size).astype(np.float32)
+        red = tm.REGISTRY.counter("mx_trainer_reduce_seconds_total", "")
+        hid = tm.REGISTRY.counter(
+            "mx_trainer_reduce_hidden_seconds_total", "")
+        trainer.step(1)                     # warmup: compile + init
+        params[-1].data().asnumpy()
+        r0, h0 = red.value, hid.value
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            trainer.step(1)
+        params[-1].data().asnumpy()
+        wall = (_t.perf_counter() - t0) / steps * 1e3
+        r, h = red.value - r0, hid.value - h0
+        return wall, r, h
+
+    try:
+        wall_s, red_s, hid_s = run(0)
+        # The serial-ACCOUNTING row must exercise the pipelined step's
+        # own hidden-time arithmetic, not the legacy path (which never
+        # touches the counters): a no-op global-norm clip routes
+        # depth=0 through _step_pipelined, where every reduce second
+        # is inline main-thread wait. A broken accounting that
+        # reported hidden time serially WOULD trip this row.
+        _, red_s2, hid_s2 = run(0, clip=1e12)
+        eff_serial = hid_s2 / red_s2 if red_s2 > 0 else 0.0
+        wall_o, red_o, hid_o = run(4)
+        eff = hid_o / red_o if red_o > 0 else 0.0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    _emit("trainer_overlap_step_ms_serial", round(wall_s, 3), "ms")
+    _emit("trainer_overlap_step_ms_depth4", round(wall_o, 3), "ms")
+    _emit("fused_overlap_efficiency_serial", round(eff_serial, 4), "share")
+    # THE CONTRACT ROW: >= 0.30 of reduce time hidden behind applies.
+    _emit("fused_overlap_efficiency", round(eff, 4), "share")
 
 
 def _checkpoint_rows():
@@ -1347,6 +1462,11 @@ def main():
         _trainer_rows()
     except Exception:
         print("bench trainer section failed:", file=sys.stderr)
+        traceback.print_exc()
+    try:
+        _trainer_overlap_rows()
+    except Exception:
+        print("bench trainer_overlap section failed:", file=sys.stderr)
         traceback.print_exc()
     try:
         _checkpoint_rows()

@@ -53,8 +53,8 @@ from .. import log as _log
 __all__ = ["CachedFunction", "CompileCacheStore", "cached_compile",
            "maybe_cached_jit", "configure", "reset", "enabled",
            "active_store", "attach_kvstore", "set_distributor",
-           "backend_fingerprint", "make_key", "entry_name",
-           "ENTRY_FORMAT"]
+           "shared_filesystem", "backend_fingerprint", "make_key",
+           "entry_name", "ENTRY_FORMAT"]
 
 _hits_total = _tm.REGISTRY.counter(
     "mx_compile_cache_hits_total",
@@ -145,12 +145,29 @@ def set_distributor(distributor):
     return distributor
 
 
+def shared_filesystem():
+    """``MXNET_COMPILE_CACHE_SHARED=1``: every rank's
+    ``MXNET_COMPILE_CACHE`` points at ONE shared directory (NFS,
+    GCS-fuse). Safe by construction — entries commit through the
+    checkpoint tmp+fsync+rename seam, so concurrent ranks see either a
+    whole entry or none, and a racing double-compile just commits the
+    same bytes twice. The kvstore ``cc_*`` channel is redundant then:
+    :func:`attach_kvstore` becomes a no-op (no pushes, no probe
+    round-trips)."""
+    from .. import env as _env
+
+    return bool(_env.get("MXNET_COMPILE_CACHE_SHARED"))
+
+
 def attach_kvstore(kv):
     """Convenience: wire a :class:`.distribute.CacheDistributor` over a
     kvstore-shaped transport (``KVStoreDist`` or a LocalBus endpoint
     with the ``cc_*`` commands). No-op returning None when the cache is
-    disabled."""
-    if not enabled():
+    disabled — or in shared-filesystem mode
+    (``MXNET_COMPILE_CACHE_SHARED=1``), where the common cache
+    directory already distributes entries and the kvstore channel would
+    only duplicate bytes."""
+    if not enabled() or shared_filesystem():
         return None
     from .distribute import CacheDistributor
 

@@ -49,6 +49,23 @@ CATALOGUE = [
     Knob("MXNET_FUSED_BUCKET_MB", int, 25, "fused_update.py",
          "coalescing bucket size for fused gradient aggregation "
          "(DDP-style; traffic scales with ceil(params/bucket))", False),
+    Knob("MXNET_FUSED_OVERLAP_DEPTH", int, 2, "gluon/trainer.py",
+         "comm/compute overlap window for the fused step: up to this "
+         "many gradient buckets reduce ahead of their fused applies "
+         "(0 = serial reduce-then-apply)", False),
+    Knob("MXNET_FUSED_DONATE", str, "auto", "fused_update.py",
+         "donate flat weight/state buffers into the fused chunk "
+         "executables (halves the fused cache's steady-state HBM): "
+         "auto = accelerator backends only, 1/0 force", False),
+    Knob("MXNET_MP_LOWP_DTYPES", str, "float16,bfloat16", "optimizer.py",
+         "low-precision weight dtypes that keep an fp32 master copy "
+         "when multi_precision=True (mp_sgd/mp_adam master-weight "
+         "contract)", False),
+    Knob("MXNET_COMPILE_CACHE_SHARED", bool, False, "compile/",
+         "every rank's MXNET_COMPILE_CACHE points at ONE shared "
+         "directory (NFS/GCS-fuse): skip the kvstore cc_* distribution "
+         "channel — entries already commit atomically, so concurrent "
+         "ranks are safe", False),
     Knob("MXNET_PROFILER_AUTOSTART", int, 0, "profiler.py",
          "start device+dispatch profiling at import", False),
     Knob("MXNET_PROFILE_HZ", float, 67.0, "telemetry/profiling.py",

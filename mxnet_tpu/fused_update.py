@@ -45,9 +45,14 @@ established (one compile per signature, then pure cache hits):
     the steady-state step moves O(params) fewer buffers through the
     runtime.
 
-    Anything the table does not cover (row-sparse gradients,
-    multi-precision fp16 master weights, exotic optimizers) falls back
-    to the per-parameter updater, entry by entry.
+    Multi-precision weights (fp16/bf16 under ``multi_precision=True``)
+    ride the same table through per-family ``mp_*`` variants: the fp32
+    master lives as the LAST flat state slot, the gradient upcasts
+    in-graph, and the low-precision weight slices back out as a cast
+    of the master — elementwise-identical to the loop path's
+    ``update_multi_precision``. Anything the table does not cover
+    (row-sparse gradients, exotic optimizers, odd state layouts) falls
+    back to the per-parameter updater, entry by entry.
 
 :class:`GradBucketer`
     Flattens many same-dtype gradients into ~25MB coalesced buckets
@@ -253,14 +258,42 @@ def _spec_for(opt):
 
 
 class _Spec:
-    __slots__ = ("name", "n_states", "statics", "body", "host_lr")
+    __slots__ = ("name", "n_states", "statics", "body", "host_lr",
+                 "hyp_dtype", "mp", "base_k")
 
-    def __init__(self, name, n_states, statics, body, host_lr=None):
+    def __init__(self, name, n_states, statics, body, host_lr=None,
+                 hyp_dtype=None, mp=False, base_k=None):
         self.name = name
         self.n_states = n_states
         self.statics = statics
         self.body = body
         self.host_lr = host_lr or (lambda opt, index, lr: lr)
+        # lr/wd runtime vectors are built in this dtype (None = the
+        # weight dtype). Master-weight variants compute in fp32.
+        self.hyp_dtype = hyp_dtype
+        self.mp = mp
+        self.base_k = n_states if base_k is None else base_k
+
+
+def _mp_spec(spec):
+    """Master-weight variant of a supported family: the fp32 master
+    lives as the LAST flat state slot, the low-precision weight is a
+    per-step cast of it (the mp_sgd/mp_adam contract generalized to
+    every fused family). Elementwise math matches the loop path's
+    ``update_multi_precision`` exactly: grad casts to the master dtype,
+    the base body runs in fp32, the weight slices back as
+    ``master.astype(weight.dtype)``."""
+    base_body, base_k = spec.body, spec.n_states
+
+    def body(w, g, s, lr, wd, rs):
+        inner, w32 = tuple(s[:base_k]), s[base_k]
+        new_w32, new_inner = base_body(w32, g.astype(w32.dtype), inner,
+                                       lr, wd, rs)
+        return new_w32.astype(w.dtype), tuple(new_inner) + (new_w32,)
+
+    return _Spec("mp_" + spec.name, base_k + 1, ("mp",) + spec.statics,
+                 body, spec.host_lr, hyp_dtype=np.float32, mp=True,
+                 base_k=base_k)
 
 
 class _FlatView(NDArray):
@@ -310,13 +343,31 @@ class _FlatView(NDArray):
         self._chunk.stale = True
 
 
+def donate_enabled():
+    """Whether chunk executables donate their flat weight/state input
+    buffers (``MXNET_FUSED_DONATE``: auto = on for accelerator
+    backends, off on CPU where PJRT ignores donation and warns). With
+    donation the steady-state fused cache holds ONE copy of the flat
+    weights/state instead of two — XLA aliases the input buffer to the
+    same-shaped output, halving the cache's HBM footprint."""
+    raw = str(_env.get("MXNET_FUSED_DONATE", "auto") or "auto").lower()
+    if raw in ("1", "true", "yes", "on"):
+        return True
+    if raw in ("0", "false", "no", "off"):
+        return False
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 class _ApplyChunk:
     """One compiled flat-apply executable plus its cached flat weight
     and state buffers."""
 
     __slots__ = ("exec_fn", "flatten_fn", "shapes", "sizes", "offsets",
                  "n", "k", "flat_w", "flat_s", "weights", "wver",
-                 "views", "state_objs", "stale", "compiled", "cc")
+                 "views", "state_objs", "stale", "compiled", "cc",
+                 "mp", "base_k", "with_scale")
 
     def __init__(self, exec_fn, flatten_fn, shapes, sizes, offsets, k):
         self.exec_fn = exec_fn
@@ -326,6 +377,9 @@ class _ApplyChunk:
         self.offsets = offsets
         self.n = len(shapes)
         self.k = k
+        self.mp = False
+        self.base_k = k
+        self.with_scale = False
         self.flat_w = None
         self.flat_s = [None] * k
         self.weights = None
@@ -349,8 +403,8 @@ class FusedApplier:
 
     ``apply(entries)`` with ``entries = [(index, weight, grad)]`` runs
     the fused executable(s) and returns the entries it could NOT handle
-    (unsupported optimizer family, sparse gradient, multi-precision
-    master-weight state, ...) for the caller's per-param fallback loop.
+    (unsupported optimizer family, sparse gradient, unrecognized state
+    layout, ...) for the caller's per-param fallback loop.
     """
 
     def __init__(self, updater):
@@ -360,12 +414,21 @@ class FusedApplier:
         # objects are identity-stable across steps (autograd writes
         # gradients into the same buffers), so the per-step grouping /
         # chunking / signature hashing collapses to one O(n) identity
-        # sweep.
-        self._plan = None
+        # sweep. Keyed per entry-index run so the overlapped Trainer's
+        # per-bucket applies each keep their own hot plan.
+        self._plans = {}
         # Compile-count hook, the CachedOp num_traces/on_trace pattern:
         # StepMonitor.attach_fused chains here to flag signature churn.
         self.num_compiles = 0
         self.on_compile = None
+        # Warmup accounting for StepMonitor.attach_fused: compiles are
+        # a storm signal only when `_replanning` — i.e. an existing
+        # plan is being rebuilt (signature churn), or ANY new plan is
+        # built after the first apply window completed (`_warmed`).
+        # During the very first window (the overlapped path plans one
+        # bucket at a time) every build is warmup.
+        self._replanning = False
+        self._warmed = False
         # Numeric-health hook (telemetry.NumericGuard.install): when
         # set and armed for this apply, every chunk's post-apply flat
         # vector gets one device-side isfinite reduction — O(buckets),
@@ -392,9 +455,32 @@ class FusedApplier:
             return tuple(state)
         return None
 
+    def _state_tuple_mp(self, state, base_k):
+        """Normalize a multi-precision state entry ``(inner_state,
+        master_weight)`` to the flat ``inner... + (master,)`` tuple the
+        mp chunk body expects, or None when the layout doesn't match."""
+        if not (isinstance(state, (list, tuple)) and len(state) == 2):
+            return None
+        inner, master = state
+        if not isinstance(master, NDArray) or \
+                isinstance(master, _sp.BaseSparseNDArray):
+            return None
+        inner_t = self._state_tuple(inner, base_k)
+        if inner_t is None:
+            return None
+        return inner_t + (master,)
+
+    def _state_for(self, state, ch_or_spec):
+        """Chunk/spec-aware normalization (mp layouts nest)."""
+        if ch_or_spec.mp:
+            return self._state_tuple_mp(state, ch_or_spec.base_k)
+        return self._state_tuple(state, ch_or_spec.k
+                                 if isinstance(ch_or_spec, _ApplyChunk)
+                                 else ch_or_spec.n_states)
+
     # -- one compile per (family, statics, shapes) signature ------------------
 
-    def _build_chunk(self, spec, sig, shapes, rescale):
+    def _build_chunk(self, spec, sig, shapes, rescale, with_scale=False):
         import jax
         import jax.numpy as jnp
 
@@ -422,7 +508,7 @@ class FusedApplier:
         # distinct value there too): as a runtime scalar, XLA can't
         # constant-fold the rescale=1.0 multiply away, and the extra
         # in-kernel op perturbs FMA contraction by an ulp vs the loop.
-        def chunk_fn(grads, flat_w, flat_s, lrs, wds):
+        def chunk_fn(grads, flat_w, flat_s, lrs, wds, *scale):
             # Concat + elementwise + slice: positionwise identical to
             # running the body once per parameter, in one executable
             # whose compute is a single vectorized pass.
@@ -430,6 +516,14 @@ class FusedApplier:
             if pad:
                 parts.append(jnp.zeros((pad,), grads[0].dtype))
             g = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            if with_scale:
+                # Fused global-norm clip: one runtime scalar scales the
+                # whole flat gradient (the per-param `a *= scale` of
+                # gluon.utils.clip_global_norm, inside the executable).
+                # Pad lanes stay zero. Compiled only when the Trainer
+                # clips — unclipped executables are byte-identical to
+                # the pre-clip ones.
+                g = g * scale[0].astype(g.dtype)
             hyp = (lrs, wds)
             if pad:
                 z = jnp.zeros((1,), lrs.dtype)
@@ -466,13 +560,27 @@ class FusedApplier:
         # same seam uncounted (it was never part of mx_compile_seconds).
         from . import compile as _cc
 
-        key = ("fused_apply", spec.name, repr(spec.statics), repr(sig))
+        # Donation (TPU/GPU): the flat weight and state inputs alias
+        # their same-shaped outputs, so the steady-state fused cache
+        # holds one flat copy, not two. The mp variant's low-precision
+        # flat_w is dtype-only (the master drives), so only the state
+        # tuple (which carries the master) donates there.
+        jit_kwargs = {}
+        if donate_enabled():
+            jit_kwargs["donate_argnums"] = (2,) if spec.mp else (1, 2)
+        key = ("fused_apply", spec.name, repr(spec.statics), repr(sig),
+               "scale" if with_scale else "",
+               "donate" if jit_kwargs else "")
         ch = _ApplyChunk(
-            _cc.maybe_cached_jit(chunk_fn, "fused_apply", key_parts=key),
+            _cc.maybe_cached_jit(chunk_fn, "fused_apply", key_parts=key,
+                                 **jit_kwargs),
             _cc.maybe_cached_jit(flat_cat, "fused_flatten",
                                  key_parts=("fused_flatten", repr(sig)),
                                  observe=False),
             tuple(shapes), sizes, offsets, k)
+        ch.mp = spec.mp
+        ch.base_k = spec.base_k
+        ch.with_scale = with_scale
         ch.cc = isinstance(ch.exec_fn, _cc.CachedFunction)
         self._chunks[sig] = ch
         self.num_compiles += 1
@@ -499,7 +607,7 @@ class FusedApplier:
                         for e, so in zip(group, ch.state_objs))
         if fresh:
             return True
-        sts = [self._state_tuple(states[e[0]], ch.k) for e in group]
+        sts = [self._state_for(states[e[0]], ch) for e in group]
         if any(s is None for s in sts):
             return False
         ch.flat_w = _dispatch("trainer::fused_flatten", ch.flatten_fn,
@@ -519,14 +627,23 @@ class FusedApplier:
                 views = tuple(
                     _FlatView(ch, j, ch.offsets[i], ch.sizes[i],
                               ch.shapes[i], ctx) for j in range(ch.k))
-                obj = views[0] if ch.k == 1 else views
+                if ch.mp:
+                    # Preserve the (inner_state, master) nesting the
+                    # loop path / checkpoints expect — the master is
+                    # the LAST flat slot.
+                    inner = views[:ch.base_k]
+                    inner_obj = None if ch.base_k == 0 else \
+                        inner[0] if ch.base_k == 1 else inner
+                    obj = (inner_obj, views[ch.base_k])
+                else:
+                    obj = views[0] if ch.k == 1 else views
                 states[e[0]] = obj
                 ch.views.append(views)
                 ch.state_objs.append(obj)
         ch.stale = False
         return True
 
-    def _run_chunk(self, spec, gk, ch, group, opt, jnp):
+    def _run_chunk(self, spec, gk, ch, group, opt, jnp, grad_scale=None):
         """Sync + dispatch + commit one chunk. Returns [] or the group's
         (index, weight, grad) triples when it must fall back."""
         from . import engine as _engine
@@ -542,13 +659,18 @@ class FusedApplier:
             opt._update_count(index)
             lrs.append(spec.host_lr(opt, index, opt._get_lr(index)))
             wds.append(opt._get_wd(index))
-        wdt = gk[1]
-        # lr/wd are RUNTIME vector inputs in the weight dtype (one
-        # host->device rounding — the same bits the loop path's baked
-        # attr gets after _c's cast), so LR schedules never retrace;
-        # rescale is baked into the executable (see _build_chunk).
+        wdt = spec.hyp_dtype or gk[1]
+        # lr/wd are RUNTIME vector inputs in the weight dtype (fp32 for
+        # master-weight variants — one host->device rounding, the same
+        # bits the loop path's baked attr gets after _c's cast), so LR
+        # schedules never retrace; rescale is baked into the executable
+        # (see _build_chunk).
         lrs = jnp.asarray(np.asarray(lrs, wdt))
         wds = jnp.asarray(np.asarray(wds, wdt))
+        scale_args = ()
+        if ch.with_scale:
+            scale_args = (jnp.asarray(
+                np.float32(1.0 if grad_scale is None else grad_scale)),)
         # Under the persistent cache the CachedFunction accounts real
         # compiles itself (a warm restart's first dispatch is a load,
         # not a compile — it must not count).
@@ -556,7 +678,7 @@ class FusedApplier:
         outs, new_w, new_s = _dispatch(
             "trainer::fused_apply", ch.exec_fn,
             tuple(e[2]._data for e in group), ch.flat_w,
-            tuple(ch.flat_s), lrs, wds,
+            tuple(ch.flat_s), lrs, wds, *scale_args,
             optimizer=spec.name, params=len(group))
         if t_compile is not None:
             # jit compiles synchronously inside the first dispatch (the
@@ -598,37 +720,72 @@ class FusedApplier:
 
     # -- public ----------------------------------------------------------------
 
-    def apply(self, entries):
+    def open_guard_window(self):
+        """Arm (or not, per its cadence) the numeric guard for a window
+        of ``apply(..., manage_guard=False)`` calls — the Trainer's
+        overlapped path applies bucket-by-bucket but the guard must
+        still decide once per STEP, checking all of a step's buckets or
+        none."""
+        self._guard_armed = (self.grad_guard is not None
+                            and self.grad_guard.arm_apply())
+
+    def close_guard_window(self):
+        """Single guard sync point after every bucket of the window
+        dispatched. Also closes the warmup window: any plan built
+        after this counts toward the recompile-storm budget."""
+        if self._guard_armed and self.grad_guard is not None:
+            self.grad_guard.flush()
+        self._guard_armed = False
+        self._warmed = True
+
+    def apply(self, entries, grad_scale=None, manage_guard=True):
         """Fused-apply ``[(index, weight, grad)]``; returns the subset
-        of entries that must take the per-param fallback loop."""
+        of entries that must take the per-param fallback loop.
+
+        ``grad_scale``: optional runtime scalar multiplying every
+        gradient inside the executable (the Trainer's fused global-norm
+        clip). Presence (not value) is part of the executable
+        signature, so unclipped trainers compile exactly the same
+        chunks as before.
+
+        ``manage_guard=False``: the caller brackets several applies in
+        one :meth:`open_guard_window`/:meth:`close_guard_window` pair
+        (one guard decision + one flush per step, however many buckets
+        the step applies)."""
         opt = self.updater.optimizer
-        spec = _spec_for(opt)
-        if spec is None or not entries:
+        base_spec = _spec_for(opt)
+        if base_spec is None or not entries:
             return list(entries)
 
         import jax.numpy as jnp
 
-        # Cadence decision once per apply (not per chunk), so a
-        # guard with every=N checks all of step N's buckets or none.
-        self._guard_armed = (self.grad_guard is not None
-                             and self.grad_guard.arm_apply())
+        if manage_guard:
+            # Cadence decision once per apply (not per chunk), so a
+            # guard with every=N checks all of step N's buckets or none.
+            self.open_guard_window()
         rescale = float(opt.rescale_grad)
-        plan = self._plan
-        if plan is not None and plan[0] == spec.name \
-                and plan[1] == (spec.statics, rescale) \
+        with_scale = grad_scale is not None
+        # Plan cache keyed per entry-index run: the overlapped Trainer
+        # applies one bucket at a time, so each bucket's entry list
+        # gets its own steady-state plan instead of thrashing one slot.
+        pk = (len(entries), entries[0][0], entries[-1][0])
+        plan = self._plans.get(pk)
+        if plan is not None and plan[0] == base_spec.name \
+                and plan[1] == (base_spec.statics, rescale, with_scale) \
                 and len(entries) == plan[2] \
                 and all(e[0] == p[0] and e[1] is p[1] and e[2] is p[2]
                         for e, p in zip(entries, plan[3])):
             pending = list(plan[5])
-            for gk, ch, group in plan[4]:
+            for spec, gk, ch, group in plan[4]:
                 pending.extend(self._run_chunk(spec, gk, ch, group, opt,
-                                               jnp))
-            if self._guard_armed and self.grad_guard is not None:
-                # Single sync point AFTER every bucket dispatched.
-                self.grad_guard.flush()
+                                               jnp, grad_scale))
+            if manage_guard:
+                self.close_guard_window()
             return pending
 
+        self._replanning = plan is not None or self._warmed
         states = self.updater.states
+        mp_spec = None
         pending, groups = [], {}
         for index, weight, grad in entries:
             if index not in states:
@@ -637,18 +794,34 @@ class FusedApplier:
                 states[index] = opt.create_state_multi_precision(
                     index, weight)
                 self.updater.states_synced[index] = True
-            st = self._state_tuple(states[index], spec.n_states)
-            if st is None or isinstance(grad, _sp.BaseSparseNDArray) \
+            if isinstance(grad, _sp.BaseSparseNDArray) \
                     or isinstance(weight, _sp.BaseSparseNDArray) \
-                    or weight._data.dtype.kind != "f":
+                    or weight._data.dtype.kind not in "fV":
+                # kind "V" admits bfloat16 (numpy reports ml_dtypes
+                # extension floats as void-kind); integers and bools
+                # still fall back.
+                pending.append((index, weight, grad))
+                continue
+            spec = None
+            if self._state_tuple(states[index], base_spec.n_states) \
+                    is not None:
+                spec = base_spec
+            elif getattr(opt, "multi_precision", False):
+                if mp_spec is None:
+                    mp_spec = _mp_spec(base_spec)
+                if self._state_tuple_mp(states[index],
+                                        mp_spec.base_k) is not None:
+                    spec = mp_spec
+            if spec is None:
                 pending.append((index, weight, grad))
                 continue
             gk = (weight._ctx, weight._data.dtype, grad._data.dtype)
-            groups.setdefault(gk, []).append((index, weight, grad))
+            groups.setdefault((spec, gk), []).append(
+                (index, weight, grad))
 
         max_bytes = bucket_bytes()
         chunks = []
-        for gk, group in groups.items():
+        for (spec, gk), group in groups.items():
             itemsize = gk[1].itemsize
             # ~bucket-sized chunks bound compile time and keep the
             # per-step dispatch count at ceil(params/bucket).
@@ -656,19 +829,28 @@ class FusedApplier:
                     group, max_bytes,
                     lambda e: (e[1]._data.size or 1) * itemsize):
                 shapes = tuple(e[1]._data.shape for e in part)
-                sig = (spec.name, spec.statics, gk, shapes, rescale)
+                sig = (spec.name, spec.statics, gk, shapes, rescale,
+                       with_scale)
                 ch = self._chunks.get(sig)
                 if ch is None:
-                    ch = self._build_chunk(spec, sig, shapes, rescale)
-                chunks.append((gk, ch, part))
-        self._plan = (spec.name, (spec.statics, rescale), len(entries),
-                      list(entries), chunks, list(pending))
+                    ch = self._build_chunk(spec, sig, shapes, rescale,
+                                           with_scale)
+                chunks.append((spec, gk, ch, part))
+        while len(self._plans) > 64:   # bounded: ~bucket count in play
+            # Oldest-inserted first: retired generations' plans (which
+            # pin their entries' NDArrays) go before the current
+            # generation's hot per-bucket plans.
+            self._plans.pop(next(iter(self._plans)))
+        self._plans[pk] = (base_spec.name,
+                           (base_spec.statics, rescale, with_scale),
+                           len(entries), list(entries), chunks,
+                           list(pending))
         pending = list(pending)
-        for gk, ch, part in chunks:
-            pending.extend(self._run_chunk(spec, gk, ch, part, opt, jnp))
-        if self._guard_armed and self.grad_guard is not None:
-            # Single sync point AFTER every bucket dispatched.
-            self.grad_guard.flush()
+        for spec, gk, ch, part in chunks:
+            pending.extend(self._run_chunk(spec, gk, ch, part, opt, jnp,
+                                           grad_scale))
+        if manage_guard:
+            self.close_guard_window()
         return pending
 
 
@@ -708,6 +890,21 @@ class _Bucket:
         self.store_key = "__fused_grad_bucket_%d" % bucket_id
         self._flatten = None
         self._unflatten = None
+        self._sumsq = None
+
+    def sumsq(self, flat):
+        """One executable: fp32 sum of squares of this bucket's flat
+        gradient (XLA lowers the reduction as a tree-reduce). The
+        Trainer's fused global-norm clip sums these per-bucket scalars
+        on host instead of issuing one norm per parameter."""
+        if self._sumsq is None:
+            import jax
+            import jax.numpy as jnp
+
+            self._sumsq = jax.jit(
+                lambda f: jnp.sum(jnp.square(f.astype(jnp.float32))))
+        return _dispatch("trainer::bucket_sumsq", self._sumsq,
+                         flat._data, bucket=self.id)
 
     def flatten(self, arrays, ctx):
         """One executable: ravel+concat this bucket's gradients."""

@@ -1,23 +1,30 @@
-"""2-bit gradient compression with error feedback.
+"""2-bit / 1-bit gradient compression with error feedback.
 
 Reference: src/kvstore/gradient_compression.h:37-134 (GradientCompression
 with ``kTwoBit`` type, pos/neg thresholds), gradient_compression.cc/.cu
-(Quantize2BitKernel / Dequantize2BitKernel), docs/faq/gradient_compression.md.
+(Quantize2BitKernel / Dequantize2BitKernel), docs/faq/gradient_compression.md;
+the 1-bit codec follows the signSGD/1-bit-SGD line (Seide et al. 2014):
+sign quantization whose bias the same error-feedback residual corrects.
 
-Semantics preserved: each gradient element is quantized to one of
-{neg_threshold, 0, pos_threshold} — values ``>= pos_threshold`` encode as
-positive, ``<= neg_threshold`` as negative, the rest as zero — and the
-quantization error is kept in a per-key residual that is added to the next
-gradient before quantizing (error feedback), so the compressed stream is
-unbiased over time. Four 2-bit codes pack per byte (the reference packs 16
-per float32 word; byte packing is the same 4x on-the-wire reduction per
+Semantics preserved: for ``2bit`` each gradient element quantizes to one
+of {neg_threshold, 0, pos_threshold} — values ``>= pos_threshold`` encode
+as positive, ``<= neg_threshold`` as negative, the rest as zero; for
+``1bit`` every element quantizes to ``sign(v) * threshold`` (one bit per
+element, 32x on the wire). In both, the quantization error is kept in a
+per-key residual that is added to the next gradient before quantizing
+(error feedback), so the compressed stream is unbiased over time. Codes
+byte-pack (four 2-bit / eight 1-bit codes per byte; the reference packs
+16 per float32 word — byte packing is the same on-the-wire reduction per
 element and keeps the codec a pair of vectorized numpy expressions).
 
 TPU-native placement: this codec runs on the host side of the DCN
 parameter-server path (kvstore_dist.py) — the worker compresses the
 locally XLA-reduced gradient once per push; intra-host reduction over ICI
 is never compressed (matching the reference, which compresses only the
-worker→server ps-lite leg, kvstore_dist.h:334-366).
+worker→server ps-lite leg, kvstore_dist.h:334-366). The fused Trainer's
+coalesced gradient buckets cross this same seam: residuals key by the
+(stable) bucket-shard subkey, so error feedback per bucket survives
+across steps and compression composes with bucketed fusion.
 """
 from __future__ import annotations
 
@@ -31,21 +38,23 @@ _NEG_CODE = 2
 
 
 class GradientCompression:
-    """The 2-bit codec plus per-key error-feedback residuals."""
+    """The 2-bit / 1-bit codecs plus per-key error-feedback residuals."""
 
     def __init__(self, params=None):
         params = dict(params or {})
         ctype = params.get("type", "2bit")
-        if ctype != "2bit":
-            raise ValueError("unsupported compression type %r (only '2bit', "
-                             "reference gradient_compression.h:62)" % ctype)
+        if ctype not in ("2bit", "1bit"):
+            raise ValueError("unsupported compression type %r (only '2bit' "
+                             "and '1bit'; reference "
+                             "gradient_compression.h:62)" % ctype)
+        self.type = ctype
         self.threshold = float(params.get("threshold", 0.5))
         if self.threshold <= 0:
             raise ValueError("threshold must be positive")
         self._residual = {}
 
     def get_params(self):
-        return {"type": "2bit", "threshold": self.threshold}
+        return {"type": self.type, "threshold": self.threshold}
 
     # -- codec ---------------------------------------------------------------
 
@@ -62,6 +71,17 @@ class GradientCompression:
             res = np.zeros(grad.shape, dtype=np.float32)
         v = grad + res
         pos, neg = self.threshold, -self.threshold
+        if self.type == "1bit":
+            # sign quantization: every element transfers as ±threshold
+            # (one bit); zero maps to -t and error feedback repays it.
+            bits = (v > 0.0)
+            decompressed = np.where(bits, pos, neg).astype(np.float32)
+            self._residual[key] = v - decompressed
+            flat = bits.reshape(-1)
+            packed = np.packbits(flat.astype(np.uint8))
+            meta = {"type": "1bit", "shape": grad.shape,
+                    "threshold": self.threshold}
+            return packed.tobytes(), meta
         codes = np.zeros(v.shape, dtype=np.uint8)
         codes[v >= pos] = _POS_CODE
         codes[v <= neg] = _NEG_CODE
@@ -76,16 +96,22 @@ class GradientCompression:
         quads = flat.reshape(-1, 4)
         packed = (quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
                   | (quads[:, 3] << 6)).astype(np.uint8)
-        meta = {"shape": grad.shape, "threshold": self.threshold}
+        meta = {"type": "2bit", "shape": grad.shape,
+                "threshold": self.threshold}
         return packed.tobytes(), meta
 
     @staticmethod
     def decompress(packed, meta):
-        """(bytes, meta) -> np.ndarray of {−t, 0, +t} values."""
+        """(bytes, meta) -> np.ndarray of quantized values (dispatches
+        on ``meta["type"]``; metas without one predate 1-bit = 2bit)."""
         t = float(meta["threshold"])
         shape = tuple(meta["shape"])
         n = int(np.prod(shape)) if shape else 1
         b = np.frombuffer(packed, dtype=np.uint8)
+        if meta.get("type", "2bit") == "1bit":
+            bits = np.unpackbits(b)[:n]
+            return np.where(bits == 1, t, -t).astype(np.float32) \
+                .reshape(shape)
         codes = np.empty((b.size, 4), dtype=np.uint8)
         codes[:, 0] = b & 0x3
         codes[:, 1] = (b >> 2) & 0x3

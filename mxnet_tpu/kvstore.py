@@ -23,12 +23,54 @@ value into the provided output arrays on their own devices.
 from __future__ import annotations
 
 import pickle
+import time
 
 from .context import cpu
 from .ndarray.ndarray import NDArray
 from .ndarray import sparse as _sparse
 
-__all__ = ["KVStore", "KVStoreLocal", "create"]
+__all__ = ["KVStore", "KVStoreLocal", "PullHandle", "create"]
+
+
+class PullHandle:
+    """Completion handle for :meth:`KVStore.pull_async`.
+
+    ``wait()`` blocks until the pull landed in its ``out`` arrays and
+    re-raises any transport error there — a caller that never waits
+    never observes the error, so always wait before reading the outs.
+    ``seconds`` (valid after completion) is the wall time the pull
+    spent in the store, which the Trainer's overlap telemetry charges
+    as reduce time.
+    """
+
+    __slots__ = ("_event", "_error", "seconds", "inline")
+
+    def __init__(self):
+        import threading
+
+        self._event = threading.Event()
+        self._error = None
+        self.seconds = 0.0
+        # True when the pull ran synchronously inside pull_async (the
+        # base-class/local-store case): its time is already inside the
+        # caller's own wall clock, so overlap accounting must not add
+        # `seconds` again. Set by capability, never by timing.
+        self.inline = False
+
+    def _finish(self, error=None, seconds=0.0):
+        self._error = error
+        self.seconds = seconds
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("pull did not complete within %r s"
+                               % (timeout,))
+        if self._error is not None:
+            raise self._error
 
 
 def _key_list(key):
@@ -97,6 +139,26 @@ class KVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         raise NotImplementedError
 
+    def pull_async(self, key, out=None, priority=0, ignore_sparse=True):
+        """Issue a pull and return a :class:`PullHandle` instead of
+        blocking — the seam the Trainer's overlapped reduce→apply
+        pipeline drains (bucket i's apply dispatches while bucket i+1
+        is still pulling). Local stores complete synchronously (their
+        "transport" is an async XLA dispatch already); ``dist_*``
+        stores run the wire round-trip on a background thread. Errors
+        surface on ``handle.wait()``."""
+        handle = PullHandle()
+        handle.inline = True
+        t0 = time.perf_counter()
+        try:
+            self.pull(key, out=out, priority=priority,
+                      ignore_sparse=ignore_sparse)
+        except BaseException as exc:      # noqa: BLE001 — relayed
+            handle._finish(exc, time.perf_counter() - t0)
+            return handle
+        handle._finish(None, time.perf_counter() - t0)
+        return handle
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         raise NotImplementedError
 
@@ -116,7 +178,7 @@ class KVStore:
         self.set_updater(opt.get_updater(optimizer))
 
     def set_gradient_compression(self, compression_params):
-        """2-bit gradient compression knobs (reference
+        """2-bit / 1-bit gradient compression knobs (reference
         gradient_compression.h:37-134). Stored; applied on the DCN path."""
         self._compression_params = dict(compression_params)
 

@@ -27,12 +27,15 @@ from __future__ import annotations
 import atexit
 import os
 import pickle
+import queue
+import threading
+import time
 import zlib
 
 import numpy as np
 
 from .context import cpu
-from .kvstore import KVStoreLocal, _key_list, _val_list
+from .kvstore import KVStoreLocal, PullHandle, _key_list, _val_list
 from .kvstore_server import _client
 from .ndarray import sparse as _sparse
 from .ndarray.ndarray import NDArray
@@ -64,10 +67,24 @@ class KVStoreDist(KVStoreLocal):
         self._compression = None
         self._closed = False
 
+        # One lock serializes every server-connection exchange: the
+        # request/reply framing is per-connection, so the Trainer's
+        # overlap pipeline (pushes from its comm thread, pulls from the
+        # async-pull thread) must never interleave messages with each
+        # other or with foreground RPCs. Reentrant: push → _post nests.
+        self._comm_lock = threading.RLock()
+        self._pull_q = None
+        self._pull_thread = None
+        # Linearizes pull_async enqueues against close()'s shutdown
+        # sentinel: a task is either ahead of the sentinel (processed)
+        # or its handle is finished with an error — never parked
+        # unfinished behind it.
+        self._pull_lifecycle = threading.Lock()
+
         sched_addr = (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
                       int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")))
         self._sched = _client(sched_addr)
-        self._sched_lock = __import__("threading").Lock()
+        self._sched_lock = threading.Lock()
         # A restarted worker rejoins under its old rank and skips the
         # startup rendezvous (reference ps::Postoffice::is_recovery,
         # kvstore_dist.h:52-55).
@@ -181,51 +198,56 @@ class KVStoreDist(KVStoreLocal):
 
     def _post(self, server_idx, msg):
         """Fire-and-collect-later send; reply must be a plain ack."""
-        if self._pending_acks[server_idx] >= self._MAX_PENDING_ACKS:
-            self._drain_acks(server_idx)
-        try:
-            self._servers[server_idx].send(msg)
-        except (OSError, EOFError, BrokenPipeError):
-            self._reconnect(server_idx)
-            self._servers[server_idx].send(msg)
-        self._pending_acks[server_idx] += 1
+        with self._comm_lock:
+            if self._pending_acks[server_idx] >= self._MAX_PENDING_ACKS:
+                self._drain_acks(server_idx)
+            try:
+                self._servers[server_idx].send(msg)
+            except (OSError, EOFError, BrokenPipeError):
+                self._reconnect(server_idx)
+                self._servers[server_idx].send(msg)
+            self._pending_acks[server_idx] += 1
 
     def _drain_acks(self, server_idx=None):
         """Collect outstanding acks (surfacing any deferred errors)."""
         idxs = [server_idx] if server_idx is not None \
             else range(len(self._servers))
-        for i in idxs:
-            conn = self._servers[i]
-            while self._pending_acks[i]:
-                try:
-                    reply = conn.recv()
-                except (OSError, EOFError):
-                    # Server died with acks in flight; reconnect and move
-                    # on — the retried ops re-post on the new connection.
-                    self._reconnect(i)
-                    break
-                self._pending_acks[i] -= 1
-                if reply[0] == "error":
-                    raise RuntimeError("kvstore server %d: %s"
-                                       % (i, reply[1]))
+        with self._comm_lock:
+            for i in idxs:
+                conn = self._servers[i]
+                while self._pending_acks[i]:
+                    try:
+                        reply = conn.recv()
+                    except (OSError, EOFError):
+                        # Server died with acks in flight; reconnect and
+                        # move on — the retried ops re-post on the new
+                        # connection.
+                        self._reconnect(i)
+                        break
+                    self._pending_acks[i] -= 1
+                    if reply[0] == "error":
+                        raise RuntimeError("kvstore server %d: %s"
+                                           % (i, reply[1]))
 
     def _call(self, server_idx, msg):
         """Blocking RPC for value-bearing requests; retries once through
         a reconnect if the server went away mid-exchange."""
-        self._drain_acks(server_idx)
-        for attempt in (0, 1):
-            conn = self._servers[server_idx]
-            try:
-                conn.send(msg)
-                reply = conn.recv()
-                break
-            except (OSError, EOFError, BrokenPipeError):
-                if attempt:
-                    raise
-                self._reconnect(server_idx)
-        if reply[0] == "error":
-            raise RuntimeError("kvstore server %d: %s" % (server_idx, reply[1]))
-        return reply[1] if len(reply) > 1 else None
+        with self._comm_lock:
+            self._drain_acks(server_idx)
+            for attempt in (0, 1):
+                conn = self._servers[server_idx]
+                try:
+                    conn.send(msg)
+                    reply = conn.recv()
+                    break
+                except (OSError, EOFError, BrokenPipeError):
+                    if attempt:
+                        raise
+                    self._reconnect(server_idx)
+            if reply[0] == "error":
+                raise RuntimeError("kvstore server %d: %s"
+                                   % (server_idx, reply[1]))
+            return reply[1] if len(reply) > 1 else None
 
     def _shards(self, key, shape, stype="default"):
         """Yield (server_idx, subkey, flat_slice) shards for a key.
@@ -338,6 +360,10 @@ class KVStoreDist(KVStoreLocal):
         if len(shards) == 1 and shards[0][2] is None:
             return np.asarray(self._call(shards[0][0],
                                          ("pull", shards[0][1]))).reshape(shape)
+        with self._comm_lock:
+            return self._fetch_sharded(k, shape, dtype, shards)
+
+    def _fetch_sharded(self, k, shape, dtype, shards):
         # Big-array shards live one-per-server (contiguous slicing across
         # all servers): issue every shard pull first, then collect — the
         # servers serve and transfer concurrently instead of one
@@ -387,6 +413,59 @@ class KVStoreDist(KVStoreLocal):
             value = self._fetch(k)
             for o in olist:
                 o[:] = value
+
+    def _ensure_pull_thread(self):
+        if self._pull_thread is None:
+            self._pull_q = queue.Queue()
+
+            def loop():
+                while True:
+                    task = self._pull_q.get()
+                    if task is None:
+                        # Shutdown: nothing can be enqueued past the
+                        # sentinel (pull_lifecycle lock), but drain
+                        # defensively so no handle ever hangs.
+                        while True:
+                            try:
+                                handle, _ = self._pull_q.get_nowait()
+                            except queue.Empty:
+                                return
+                            handle._finish(
+                                RuntimeError("kvstore is closed"))
+                    handle, args = task
+                    t0 = time.perf_counter()
+                    try:
+                        self.pull(*args)
+                    except BaseException as exc:   # noqa: BLE001 relayed
+                        handle._finish(exc, time.perf_counter() - t0)
+                        continue
+                    handle._finish(None, time.perf_counter() - t0)
+
+            self._pull_thread = threading.Thread(
+                target=loop, name="mx-kvstore-pull", daemon=True)
+            self._pull_thread.start()
+
+    def pull_async(self, key, out=None, priority=0, ignore_sparse=True):
+        """Real async pull: the wire round-trip (which a sync-mode
+        server may PARK until every worker pushed the key) runs on a
+        dedicated puller thread, so the CALLER is free — the Trainer's
+        main thread keeps unflattening/dispatching fused applies while
+        the pull is in flight. Wire-level push/pull overlap is NOT
+        claimed: the per-store comm lock serializes whole exchanges so
+        replies never interleave on a connection (per-server locks are
+        the ROADMAP follow-up that would pipeline the wire itself)."""
+        handle = PullHandle()
+        with self._pull_lifecycle:
+            if self._closed:
+                # The puller loop exited (or will, at the sentinel):
+                # complete the handle with an error now instead of
+                # letting a waiter hang on an unprocessed task.
+                handle._finish(RuntimeError("kvstore is closed"))
+                return handle
+            self._ensure_pull_thread()
+            self._pull_q.put((handle, (key, out, priority,
+                                       ignore_sparse)))
+        return handle
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows across the DCN (reference
@@ -559,7 +638,10 @@ class KVStoreDist(KVStoreLocal):
     def close(self):
         if self._closed:
             return
-        self._closed = True
+        with self._pull_lifecycle:
+            self._closed = True
+            if self._pull_q is not None:
+                self._pull_q.put(None)
         try:
             # surface any deferred push errors before tearing down
             self._drain_acks()

@@ -132,6 +132,15 @@ def _sparse_adam_update(weight, grad, mean, var, lr_t, beta1, beta2,
     weight._set_data(weight._data.at[idx].add(-step))
 
 
+def _mp_lowp_dtypes():
+    """Dtype names eligible for fp32 master weights under
+    ``multi_precision=True`` (``MXNET_MP_LOWP_DTYPES``)."""
+    from . import env as _env
+
+    raw = str(_env.get("MXNET_MP_LOWP_DTYPES"))
+    return {s.strip() for s in raw.split(",") if s.strip()}
+
+
 def register(cls):
     return registry.register(cls)
 
@@ -169,8 +178,17 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    def _wants_master(self, weight):
+        """Whether this weight keeps an fp32 master copy: low-precision
+        dtype (``MXNET_MP_LOWP_DTYPES``, default float16 + bfloat16 —
+        the reference only mastered fp16; bf16 is the TPU-native case)
+        under ``multi_precision=True``."""
+        if not self.multi_precision:
+            return False
+        return str(np.dtype(weight.dtype)) in _mp_lowp_dtypes()
+
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self._wants_master(weight):
             weight_master = weight.astype(np.float32)
             return (self.create_state(index, weight_master), weight_master)
         return self.create_state(index, weight)
@@ -179,11 +197,11 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self._wants_master(weight):
             inner_state, weight_master = state
             grad32 = grad.astype(np.float32)
             self.update(index, weight_master, grad32, inner_state)
-            weight._set_data(weight_master.astype(np.float16)._data)
+            weight._set_data(weight_master.astype(weight.dtype)._data)
         else:
             self.update(index, weight, grad, state)
 

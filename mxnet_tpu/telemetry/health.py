@@ -174,9 +174,10 @@ class StepMonitor:
         def _hook(a):
             if previous is not None:
                 previous(a)
-            if getattr(a, "_plan", None) is None:
-                # Initial build: the applier has never completed an
-                # apply, so these are the expected warmup compiles.
+            if not getattr(a, "_replanning", False):
+                # Fresh plan build (first apply for this entry run —
+                # one per bucket on the overlapped path): expected
+                # warmup compiles, not a storm.
                 return
             state["compiles"] += 1
             if state["compiles"] > budget:

@@ -75,6 +75,14 @@ _overhead_seconds = _metrics.REGISTRY.counter(
 _windows_total = _metrics.REGISTRY.counter(
     "mx_profile_windows_total",
     "Profile windows closed into the retention ring")
+_hz_gauge = _metrics.REGISTRY.gauge(
+    "mx_profile_hz",
+    "Continuous profiler's CURRENT sampling rate (adaptive sampling "
+    "backs it off when the self-accounted overhead share exceeds its "
+    "budget, and restores it as headroom returns)")
+_backoffs_total = _metrics.REGISTRY.counter(
+    "mx_profile_rate_adjustments_total",
+    "Adaptive sampling rate changes", labels=("direction",))
 
 # The active profiler: the flight recorder's `profile` bundle section,
 # the healthplane's default /debug/pprof source and DiagCollector
@@ -194,10 +202,26 @@ class ContinuousProfiler:
 
     def __init__(self, hz=None, window_s=None, retain=None, monitor=None,
                  regress_pp=10.0, min_samples=10, baseline_alpha=0.3,
-                 clock=time.monotonic, wall=time.time):
+                 clock=time.monotonic, wall=time.time,
+                 adaptive=True, overhead_budget=0.01, min_hz=2.0,
+                 perf=time.perf_counter):
         self.hz = _default_hz() if hz is None else float(hz)
         if self.hz <= 0:
             raise ValueError("hz must be > 0")
+        # Adaptive sampling: every closed window compares the sampler's
+        # self-accounted overhead share against its budget (the bench
+        # contract's <=1%) and halves the rate when over, doubling back
+        # toward the configured rate once the share drops well under —
+        # a pathological process (thousands of threads, deep stacks)
+        # degrades profile resolution instead of stealing step time.
+        self.base_hz = self.hz
+        self.adaptive = bool(adaptive)
+        self.overhead_budget = float(overhead_budget)
+        self.min_hz = float(min_hz)
+        self._perf = perf
+        # Export the live rate from construction (not only after the
+        # first adjustment) so dashboards never read a false 0.
+        _hz_gauge.set(self.hz)
         self.window_s = _default_window_s() if window_s is None \
             else float(window_s)
         self.retain = _default_retain() if retain is None else int(retain)
@@ -244,7 +268,7 @@ class ContinuousProfiler:
         profiling) — the background thread does exactly this."""
         if not self._stop.is_set():     # a closed profiler never
             _active[0] = self           # re-claims the active slot
-        t0 = time.perf_counter()
+        t0 = self._perf()
         period_us = 1e6 / self.hz
         roots = self._roots()
         own = self._own_tid if self._own_tid is not None \
@@ -266,7 +290,7 @@ class ContinuousProfiler:
             folded[path] = folded.get(path, 0.0) + period_us
             sampled += 1
         self._samples_in_window += 1
-        dt = time.perf_counter() - t0
+        dt = self._perf() - t0
         self._overhead_in_window += dt
         _samples_total.inc()
         _overhead_seconds.inc(dt)
@@ -296,12 +320,15 @@ class ContinuousProfiler:
             folded = self._folded
             samples = self._samples_in_window
             overhead = self._overhead_in_window
+            window_wall = now - self._window_started
             self._folded = {}
             self._samples_in_window = 0
             self._overhead_in_window = 0.0
             self._window_started = now
             start_wall = self._window_started_wall
             self._window_started_wall = self._wall()
+        self._adapt(window_wall, overhead)
+        with self._lock:
             if not samples:
                 return None
             self._seq += 1
@@ -311,6 +338,25 @@ class ContinuousProfiler:
         _windows_total.inc()
         self._sentinel(window)
         return window
+
+    def _adapt(self, window_wall, overhead_s):
+        """Adaptive sampling: keep the self-accounted overhead share of
+        wall time inside ``overhead_budget`` (the ≤1% contract). Over
+        budget → halve the rate (floor ``min_hz``); once the share
+        falls under a quarter of the budget → double back toward the
+        configured ``base_hz``. Hysteresis (x2 down at 1x budget, x2 up
+        at 0.25x) keeps the rate from flapping at the boundary."""
+        if not self.adaptive or window_wall <= 0:
+            return
+        share = overhead_s / window_wall
+        if share > self.overhead_budget and self.hz > self.min_hz:
+            self.hz = max(self.min_hz, self.hz / 2.0)
+            _backoffs_total.labels(direction="down").inc()
+            _hz_gauge.set(self.hz)
+        elif share < self.overhead_budget / 4.0 and self.hz < self.base_hz:
+            self.hz = min(self.base_hz, self.hz * 2.0)
+            _backoffs_total.labels(direction="up").inc()
+            _hz_gauge.set(self.hz)
 
     def _sentinel(self, window):
         """Rolling-baseline regression check: the newest window's
@@ -407,11 +453,12 @@ class ContinuousProfiler:
         self)."""
         if self._thread is None:
             self._stop.clear()
-            period = 1.0 / self.hz
 
             def loop():
                 self._own_tid = threading.get_ident()
-                while not self._stop.wait(period):
+                # Period re-read every beat: adaptive sampling may have
+                # changed self.hz since the last one.
+                while not self._stop.wait(1.0 / self.hz):
                     try:
                         self.sample()
                         self.maybe_rotate()

@@ -414,6 +414,70 @@ def test_localbus_rank1_pulls_rank0_entries(tmp_path):
     assert cf2.num_compiles == 0 and cf2.num_hits == 1
 
 
+def test_shared_filesystem_mode_skips_kvstore_channel(tmp_path,
+                                                      monkeypatch):
+    """MXNET_COMPILE_CACHE_SHARED=1 (every rank's cache dir is one
+    shared filesystem): attach_kvstore becomes a no-op — the common
+    directory already distributes entries, and pushing them over the
+    kvstore would only duplicate bytes."""
+    bus = LocalBus(num_workers=2)
+    cc.configure(str(tmp_path / "shared"))
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_SHARED", "1")
+    assert cc.shared_filesystem()
+    assert cc.attach_kvstore(bus.endpoint(0)) is None
+    assert cc._active_distributor() is None
+    jnp = _jnp()
+    cf = cc.cached_compile(lambda x: jnp.cos(x) + 1, "shared_site")
+    cf(jnp.ones((4,)))
+    assert cf.num_compiles == 1
+    assert bus._cc == {}, "entry leaked onto the kvstore channel"
+    # Without the flag the same call wires a distributor.
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_SHARED", "0")
+    assert cc.attach_kvstore(bus.endpoint(0)) is not None
+
+
+def test_shared_directory_serves_two_ranks(tmp_path, monkeypatch):
+    """Two 'ranks' (two stores) pointed at ONE directory: rank 0's
+    commit is rank 1's local hit — the shared-filesystem distribution
+    story, with no kvstore at all. Entries commit atomically, so a
+    concurrent double-compile of the same key is just a benign
+    double-commit of identical bytes."""
+    jnp = _jnp()
+    shared = str(tmp_path / "nfs")
+
+    def f(x):
+        return jnp.sqrt(x + 7)
+
+    x = jnp.ones((8,))
+    cc.configure(shared)
+    cf0 = cc.cached_compile(f, "nfs_site")
+    out0 = cf0(x)
+    assert cf0.num_compiles == 1
+    # "Another rank": fresh process-level state, same directory.
+    cc.reset()
+    cc.configure(shared)
+    cf1 = cc.cached_compile(f, "nfs_site")
+    out1 = cf1(x)
+    assert cf1.num_compiles == 0 and cf1.num_hits == 1
+    assert np.allclose(np.asarray(out0), np.asarray(out1))
+    # Concurrent same-key commits (the NFS race): both writers go
+    # through tmp+rename, the survivor is a valid entry.
+    store = cc.active_store()
+    key = make_key(["race"])
+    import threading
+
+    def put():
+        store.put(key, b"payload-bytes", {"site": "race"})
+
+    threads = [threading.Thread(target=put) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    meta, payload = store.get(key)
+    assert payload == b"payload-bytes"
+
+
 def test_distributor_entry_size_bound(tmp_path):
     bus = LocalBus(num_workers=2)
     dist = CacheDistributor(bus.endpoint(0), max_entry_bytes=64)

@@ -56,6 +56,26 @@ def test_dist_failure_detection():
     _run("dist_sync", mode="failure")
 
 
+def test_dist_overlapped_fused_training():
+    """ISSUE 13 acceptance: the overlapped bucketed reduce->apply over
+    a REAL 2-process parameter-server store — ranks end identical and
+    match a single-process serial reference bit-for-bit-close."""
+    _run("dist_sync", mode="overlap")
+
+
+@pytest.mark.parametrize("gc_type", ["2bit", "1bit"])
+def test_dist_compression_composes_with_bucketed_fusion(gc_type):
+    """2bit/1bit gradient compression rides the coalesced flat-bucket
+    path: per-bucket error-feedback residuals survive across steps and
+    ranks stay weight-identical."""
+    env = dict(_ENV, MXNET_TEST_GC_TYPE=gc_type)
+    codes = launch_local(
+        2, 2, [sys.executable, _PROG, "--kv-type", "dist_sync",
+               "--mode", "overlap_compressed"],
+        env_extra=env, timeout=300)
+    assert codes == [0, 0], codes
+
+
 def test_dist_sync_training():
     """Gluon Trainer end-to-end over dist_sync: optimizer-on-server,
     per-worker shards, identical weights across workers."""

@@ -1,0 +1,492 @@
+"""Comm/compute overlap for the fused step (ISSUE 13).
+
+Contracts under test:
+
+- The overlapped reduce->apply pipeline (MXNET_FUSED_OVERLAP_DEPTH > 0)
+  produces BIT-IDENTICAL parameters to the serial fused step and to the
+  reference-shaped per-param loop, for every fused optimizer family —
+  including a mid-run depth toggle (the acceptance criterion; params
+  are vector-aligned, the regime PR 4's bit-identity contract covers).
+- Reduce time actually hides: with a latency-injecting store the
+  overlap-efficiency metric reports hidden > 0 and per-bucket
+  trainer::bucket_overlap spans are emitted; a transport error inside
+  the window surfaces on step().
+- The fused global-norm clip (ONE tree-reduce per flat bucket, scale
+  rides the chunk executable as a runtime scalar) matches
+  gluon.utils.clip_global_norm + the per-param loop within an ulp, and
+  is bit-identical between overlapped and serial runs.
+- fp16/bf16 master weights fuse (mp_* specs over the flat vector):
+  bit-identical to update_multi_precision's per-param loop, state keeps
+  the (inner, master) nesting, save/load states round-trips.
+- update_on_kvstore folds into bucketed flat pushes/pulls for
+  elementwise families (server stores flat weight vectors), with the
+  per-key path kept for ineligible optimizers.
+- 1-bit gradient compression codec: 8 codes/byte packing and the
+  error-feedback invariant.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu.telemetry import metrics as tm
+
+
+TWO_CTX = [mx.cpu(0), mx.cpu(1)]
+
+
+@pytest.fixture
+def depth_env(monkeypatch):
+    def set_depth(d):
+        monkeypatch.setenv("MXNET_FUSED_OVERLAP_DEPTH", str(d))
+    return set_depth
+
+
+def _make_params(tag, n=6, shapes=None, dtype=np.float32, ctx=None):
+    rng = np.random.RandomState(11)
+    params = []
+    for k in range(n):
+        shape = shapes[k % len(shapes)] if shapes else \
+            ((4, 4) if k % 2 else (8,))
+        p = gluon.Parameter("ovl_%s_%d" % (tag, k), shape=shape,
+                            dtype=dtype)
+        p.initialize(ctx=ctx, init=mx.init.Constant(0.0))
+        p.set_data(nd.array(rng.randn(*shape).astype(dtype)))
+        params.append(p)
+    return params
+
+
+def _run_steps(tag, optimizer, opt_params, fused=True, steps=5, n=6,
+               ctx=TWO_CTX, grad_seed=42, **trainer_kwargs):
+    params = _make_params(tag, n=n, ctx=ctx)
+    trainer = gluon.Trainer(params, optimizer, dict(opt_params),
+                            fused=fused, **trainer_kwargs)
+    rng = np.random.RandomState(grad_seed)
+    for _ in range(steps):
+        for p in params:
+            for g in p.list_grad():
+                g[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(2)
+    return [p.data().asnumpy().copy() for p in params], trainer
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("sgd", {"learning_rate": 0.05, "wd": 1e-3, "clip_gradient": 0.5}),
+    ("adam", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.1}),
+    ("adadelta", {}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("signum", {"learning_rate": 0.01}),
+])
+def test_overlapped_bit_identical_all_families(optimizer, opt_params,
+                                               depth_env):
+    """THE acceptance cross-check: overlapped (depth 2) == serial
+    (depth 0) == per-param loop, in every bit, per fused family."""
+    depth_env(2)
+    overlapped, tr = _run_steps("o_" + optimizer, optimizer, opt_params)
+    assert tr._applier.num_compiles >= 1
+    depth_env(0)
+    serial, _ = _run_steps("s_" + optimizer, optimizer, opt_params)
+    loop, _ = _run_steps("l_" + optimizer, optimizer, opt_params,
+                         fused=False)
+    for a, b in zip(overlapped, serial):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(overlapped, loop):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_overlap_depth_toggle_midrun(monkeypatch):
+    """MXNET_FUSED_OVERLAP_DEPTH flips mid-run without perturbing a
+    single bit (the knob is read per step)."""
+    params = _make_params("toggle", ctx=TWO_CTX)
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.01})
+    rng = np.random.RandomState(42)
+    for s in range(6):
+        monkeypatch.setenv("MXNET_FUSED_OVERLAP_DEPTH",
+                           "0" if s in (2, 3) else "2")
+        for p in params:
+            for g in p.list_grad():
+                g[:] = rng.randn(*p.shape).astype(np.float32)
+        trainer.step(2)
+    mixed = [p.data().asnumpy() for p in params]
+    monkeypatch.setenv("MXNET_FUSED_OVERLAP_DEPTH", "2")
+    pure, _ = _run_steps("toggle_ref", "adam", {"learning_rate": 0.01},
+                         steps=6)
+    for a, b in zip(mixed, pure):
+        np.testing.assert_array_equal(a, b)
+
+
+class _LatencyStore(kvs.KVStoreLocal):
+    """Local store plus a synthetic wire delay per push/pull leg, and
+    optional fault injection on pull."""
+
+    def __init__(self, latency=0.002, **kwargs):
+        super().__init__(**kwargs)
+        self.latency = latency
+        self.fail_pulls_after = None
+        self.pulls = 0
+
+    @property
+    def type(self):
+        return "dist_test_latency"    # "dist" => engaged on 1 context
+
+    def push(self, key, value, priority=0):
+        time.sleep(self.latency / 2)
+        super().push(key, value, priority)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        self.pulls += 1
+        if self.fail_pulls_after is not None and \
+                self.pulls > self.fail_pulls_after:
+            raise ConnectionResetError("injected transport failure")
+        time.sleep(self.latency / 2)
+        super().pull(key, out=out, priority=priority,
+                     ignore_sparse=ignore_sparse)
+
+
+def _overlap_workload(tag, store, monkeypatch, depth=2, n=512, steps=3,
+                      **trainer_kwargs):
+    monkeypatch.setenv("MXNET_FUSED_OVERLAP_DEPTH", str(depth))
+    monkeypatch.setenv("MXNET_FUSED_BUCKET_MB", "1")
+    params = []
+    rng = np.random.RandomState(3)
+    for k in range(n):
+        p = gluon.Parameter("lat_%s_%d" % (tag, k), shape=(2048,))
+        p.initialize(init=mx.init.Constant(0.0))
+        p.set_data(nd.array(rng.randn(2048).astype(np.float32)))
+        params.append(p)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore=store, update_on_kvstore=False,
+                            **trainer_kwargs)
+    for _ in range(steps):
+        for p in params:
+            p.grad()[:] = rng.randn(2048).astype(np.float32)
+        trainer.step(1)
+    return params, trainer
+
+
+def test_overlap_hides_reduce_time_and_emits_spans(monkeypatch):
+    """With a latency store and several buckets, the runtime accounting
+    must report reduce time hidden (> 0 share) and per-bucket
+    trainer::bucket_overlap spans."""
+    from mxnet_tpu.telemetry import trace
+
+    red = tm.REGISTRY.counter("mx_trainer_reduce_seconds_total", "")
+    hid = tm.REGISTRY.counter("mx_trainer_reduce_hidden_seconds_total", "")
+    eff = tm.REGISTRY.gauge("mx_trainer_overlap_efficiency", "")
+    r0, h0 = red.value, hid.value
+    prev = trace.set_enabled(True)
+    try:
+        _overlap_workload("hide", _LatencyStore(device_mode=True),
+                          monkeypatch)
+    finally:
+        drained = trace.drain()
+        trace.set_enabled(prev)
+    assert red.value > r0
+    assert hid.value > h0, "no reduce time was hidden"
+    assert 0.0 < eff.value <= 1.0
+    names = {e[1] for _, _, events in drained for e in events}
+    assert "trainer::bucket_overlap" in names
+    assert "trainer::allreduce" in names
+
+
+def test_overlap_serial_reports_zero_hidden(monkeypatch):
+    """depth=0 with the pipelined route engaged (a global-norm clip
+    forces it): every reduce second is exposed main-thread wait, so
+    hidden stays ~0 and the efficiency gauge reads 0."""
+    hid = tm.REGISTRY.counter("mx_trainer_reduce_hidden_seconds_total", "")
+    eff = tm.REGISTRY.gauge("mx_trainer_overlap_efficiency", "")
+    h0 = hid.value
+    _overlap_workload("ser", _LatencyStore(device_mode=True),
+                      monkeypatch, depth=0, global_norm_clip=1e9)
+    assert hid.value - h0 < 1e-3
+    assert eff.value < 0.05
+
+
+def test_overlap_transport_error_surfaces_on_step(monkeypatch):
+    """A pull that dies inside the overlap window must raise from
+    step(), not hang or vanish on the comm thread."""
+    store = _LatencyStore(device_mode=True)
+    params, trainer = _overlap_workload("err", store, monkeypatch,
+                                        steps=1)
+    store.fail_pulls_after = store.pulls + 1   # fail the window's 2nd pull
+    rng = np.random.RandomState(9)
+    for p in params:
+        p.grad()[:] = rng.randn(2048).astype(np.float32)
+    with pytest.raises(ConnectionResetError):
+        trainer.step(1)
+
+
+# -- fused global-norm clip ---------------------------------------------------
+
+def _clip_run(tag, fused, clip, depth, monkeypatch, ctx=TWO_CTX,
+              use_utils=False, steps=4):
+    monkeypatch.setenv("MXNET_FUSED_OVERLAP_DEPTH", str(depth))
+    params = _make_params(tag, ctx=ctx)
+    trainer = gluon.Trainer(
+        params, "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+        fused=fused, global_norm_clip=None if use_utils else clip)
+    rng = np.random.RandomState(17)
+    for _ in range(steps):
+        for p in params:
+            for g in p.list_grad():
+                g[:] = 3.0 * rng.randn(*p.shape).astype(np.float32)
+        if use_utils:
+            # The reference recipe (single context): clip_global_norm
+            # on the raw grads, then an unclipped step.
+            gluon.utils.clip_global_norm(
+                [p.list_grad()[0] for p in params], clip)
+        trainer.step(1)
+    return [p.data().asnumpy().copy() for p in params]
+
+
+def test_global_norm_clip_overlap_equals_serial(monkeypatch):
+    a = _clip_run("gn_o", True, 0.75, 2, monkeypatch)
+    b = _clip_run("gn_s", True, 0.75, 0, monkeypatch)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_global_norm_clip_matches_reference_single_ctx(monkeypatch):
+    """Single-context: fused trainer clip vs the reference recipe
+    (gluon.utils.clip_global_norm + unclipped loop trainer). The fused
+    norm accumulates per-param f32 sums the same way utils does, so
+    the match is ulp-tight."""
+    a = _clip_run("gn_f1", True, 0.75, 0, monkeypatch, ctx=None)
+    b = _clip_run("gn_r1", False, 0.75, 0, monkeypatch, ctx=None,
+                  use_utils=True)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, rtol=1e-6, atol=1e-7)
+
+
+def test_global_norm_clip_actually_clips(monkeypatch):
+    """With clip smaller than the raw norm, the update magnitude must
+    shrink accordingly vs the unclipped run."""
+    clipped = _clip_run("gn_c", True, 0.5, 2, monkeypatch, steps=1)
+    unclipped = _clip_run("gn_u", True, 1e9, 2, monkeypatch, steps=1)
+    d_c = sum(float(np.abs(x).sum()) for x in clipped)
+    d_u = sum(float(np.abs(x).sum()) for x in unclipped)
+    assert d_c != d_u
+
+
+def test_global_norm_clip_rejects_sparse(monkeypatch):
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+    from mxnet_tpu import autograd
+
+    emb = SparseEmbedding(10, 4)
+    emb.initialize()
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, global_norm_clip=1.0)
+    with autograd.record():
+        loss = (emb(nd.array(np.array([1.0, 2.0], np.float32))) ** 2).sum()
+    loss.backward()
+    with pytest.raises(ValueError, match="dense"):
+        trainer.step(1)
+
+
+# -- mixed-precision master weights -------------------------------------------
+
+def _mp_run(tag, fused, dtype, optimizer="sgd", opt_params=None, n=4,
+            steps=5):
+    rng = np.random.RandomState(5)
+    params = []
+    for k in range(n):
+        p = gluon.Parameter("mp_%s_%d" % (tag, k), shape=(8,),
+                            dtype=dtype)
+        p.initialize(init=mx.init.Constant(0.0))
+        p.set_data(nd.array(rng.randn(8).astype(np.float32).astype(dtype)))
+        params.append(p)
+    op = dict(opt_params or {"learning_rate": 0.1, "momentum": 0.9})
+    op["multi_precision"] = True
+    trainer = gluon.Trainer(params, optimizer, op, fused=fused)
+    g = np.random.RandomState(23)
+    for _ in range(steps):
+        for p in params:
+            p.grad()[:] = nd.array(
+                g.randn(8).astype(np.float32)).astype(dtype)
+        trainer.step(2)
+    return params, trainer
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("sgd", {"learning_rate": 0.1}),
+    ("adam", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01}),
+])
+def test_mp_master_weights_fuse_bit_identical(optimizer, opt_params):
+    """fp16 weights + fp32 master flats through the fused path match
+    the per-param update_multi_precision loop in every bit — and the
+    fused path actually compiled (no silent fallback)."""
+    import jax.numpy as jnp
+
+    fp, ftr = _mp_run("f_" + optimizer, True, np.float16, optimizer,
+                      opt_params)
+    lp, _ = _mp_run("l_" + optimizer, False, np.float16, optimizer,
+                    opt_params)
+    assert ftr._applier.num_compiles >= 1, "mp entries fell back"
+    for a, b in zip(fp, lp):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+    # the master copy stays fp32 under the (inner, master) nesting
+    state = ftr._updater.states[0]
+    assert isinstance(state, tuple) and len(state) == 2
+    assert jnp.dtype(state[1].dtype) == jnp.float32
+
+
+def test_mp_bf16_master_weights(monkeypatch):
+    """bf16 weights get fp32 masters too (MXNET_MP_LOWP_DTYPES default)
+    — the TPU-native case the reference never covered."""
+    import jax.numpy as jnp
+
+    fp, ftr = _mp_run("bf16_f", True, jnp.bfloat16)
+    lp, _ = _mp_run("bf16_l", False, jnp.bfloat16)
+    assert ftr._applier.num_compiles >= 1
+    for a, b in zip(fp, lp):
+        np.testing.assert_array_equal(
+            a.data().asnumpy().astype(np.float32),
+            b.data().asnumpy().astype(np.float32))
+    state = ftr._updater.states[0]
+    assert jnp.dtype(state[1].dtype) == jnp.float32
+
+
+def test_mp_save_load_states_roundtrip(tmp_path):
+    params, trainer = _mp_run("ckpt", True, np.float16)
+    fname = str(tmp_path / "mp.states")
+    trainer.save_states(fname)
+    import pickle
+
+    blob = pickle.loads(open(fname, "rb").read())
+    inner, master = blob[0]
+    assert np.asarray(master).dtype == np.float32
+    assert np.abs(np.asarray(inner)).sum() > 0      # momentum moved
+    trainer.load_states(fname)
+    for p in params:
+        p.grad()[:] = nd.array(
+            np.ones(8, np.float32)).astype(np.float16)
+    trainer.step(1)                                  # still steps
+
+
+# -- bucketed update_on_kvstore ----------------------------------------------
+
+def test_update_on_kvstore_bucketed_traffic_and_values(monkeypatch):
+    """Optimizer-on-server over flat buckets: server holds ONE flat
+    weight vector per bucket (no per-param keys for bucketed params),
+    and the trained values match the per-param server path within the
+    PR 4 ulp contract (the server applies the same elementwise body to
+    a concatenation)."""
+    def run(fused):
+        params = _make_params("uokv_%s" % fused, ctx=TWO_CTX)
+        store = kvs.create("device")
+        trainer = gluon.Trainer(params, "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                kvstore=store, update_on_kvstore=True,
+                                fused=fused)
+        rng = np.random.RandomState(29)
+        for _ in range(4):
+            for p in params:
+                for g in p.list_grad():
+                    g[:] = rng.randn(*p.shape).astype(np.float32)
+            trainer.step(2)
+        return [p.data().asnumpy().copy() for p in params], store, trainer
+
+    bucketed, store_b, tr_b = run(True)
+    per_param, store_p, _ = run(False)
+    assert tr_b._uokv_bucketed
+    bucket_keys = [k for k in store_b._store
+                   if str(k).startswith("__fused_grad_bucket")]
+    assert bucket_keys, "no flat weight buckets on the server"
+    assert not any(isinstance(k, int) for k in store_b._store), \
+        "bucketed uokv still initialized per-param keys"
+    assert all(isinstance(k, int) for k in store_p._store)
+    for a, b in zip(bucketed, per_param):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_update_on_kvstore_ineligible_keeps_per_param():
+    """Per-key lr multipliers can't ride a flat bucket: the trainer
+    must fall back to the reference per-param server path."""
+    params = _make_params("uokv_mult", ctx=TWO_CTX)
+    store = kvs.create("device")
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                            kvstore=store, update_on_kvstore=True)
+    trainer._optimizer.set_lr_mult({0: 0.5})
+    rng = np.random.RandomState(31)
+    for p in params:
+        for g in p.list_grad():
+            g[:] = rng.randn(*p.shape).astype(np.float32)
+    trainer.step(2)
+    assert not trainer._uokv_bucketed
+    assert all(isinstance(k, int) for k in store._store)
+
+
+# -- 1-bit compression codec --------------------------------------------------
+
+def test_one_bit_compression_codec():
+    """8 codes per byte, sign quantization, error-feedback invariant:
+    residual always equals accumulated input minus accumulated
+    output, and the time-average converges to clip(g, -t, t)."""
+    from mxnet_tpu.gradient_compression import GradientCompression
+
+    gc = GradientCompression({"type": "1bit", "threshold": 0.25})
+    assert gc.get_params() == {"type": "1bit", "threshold": 0.25}
+    g = np.array([[0.2, -0.15, 0.0], [-0.05, 0.24, -3.0]], np.float32)
+    packed, meta = gc.compress("k", g)
+    assert len(packed) == 1                     # 6 bits -> 1 byte
+    dec = GradientCompression.decompress(packed, meta)
+    np.testing.assert_array_equal(dec, np.where(g > 0, 0.25, -0.25))
+    np.testing.assert_allclose(gc._residual["k"], g - dec, atol=1e-6)
+    total = dec.copy()
+    for _ in range(63):
+        p2, m2 = gc.compress("k", g)
+        total += GradientCompression.decompress(p2, m2)
+    # EF makes the stream unbiased within the codec's range: the
+    # time-average converges to the saturating clip of the input.
+    np.testing.assert_allclose(total / 64, np.clip(g, -0.25, 0.25),
+                               atol=0.26 / 8)
+    with pytest.raises(ValueError):
+        GradientCompression({"type": "4bit"})
+
+
+def test_two_bit_meta_carries_type():
+    from mxnet_tpu.gradient_compression import GradientCompression
+
+    gc = GradientCompression({"type": "2bit", "threshold": 0.5})
+    packed, meta = gc.compress("k", np.ones((3,), np.float32))
+    assert meta["type"] == "2bit"
+    # old metas without a type still decompress as 2bit
+    meta.pop("type")
+    out = GradientCompression.decompress(packed, meta)
+    np.testing.assert_array_equal(out, np.full((3,), 0.5, np.float32))
+
+
+# -- donation knob ------------------------------------------------------------
+
+def test_donation_knob(monkeypatch):
+    from mxnet_tpu import fused_update as fu
+
+    monkeypatch.setenv("MXNET_FUSED_DONATE", "1")
+    assert fu.donate_enabled()
+    monkeypatch.setenv("MXNET_FUSED_DONATE", "0")
+    assert not fu.donate_enabled()
+    monkeypatch.setenv("MXNET_FUSED_DONATE", "auto")
+    assert not fu.donate_enabled()      # CPU backend: donation inert
+
+
+def test_donation_on_still_bit_identical(monkeypatch):
+    """Forcing donation on (CPU ignores the aliasing but accepts the
+    executable) must not change a single bit."""
+    monkeypatch.setenv("MXNET_FUSED_DONATE", "1")
+    monkeypatch.setenv("MXNET_FUSED_OVERLAP_DEPTH", "2")
+    a, _ = _run_steps("don_f", "adam", {"learning_rate": 0.01})
+    monkeypatch.setenv("MXNET_FUSED_DONATE", "0")
+    b, _ = _run_steps("don_o", "adam", {"learning_rate": 0.01})
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)

@@ -915,3 +915,71 @@ def test_two_process_pod_profile_over_kvstore(tmp_path):
     assert "rank_marker_1 (" in merged
     for line in merged.splitlines():        # roots stay per-rank
         assert line.startswith(("rank0;", "rank1;"))
+
+
+def test_adaptive_sampling_backs_off_and_recovers():
+    """PR 12 follow-up: when the sampler's self-accounted overhead
+    share exceeds its <=1% budget, the rate halves (down to min_hz);
+    once the share falls well under budget it doubles back toward the
+    configured rate. Driven entirely by the fake clock + fake perf
+    counter, no thread."""
+    clock = _FakeClock()
+    profiler = telemetry.ContinuousProfiler(
+        hz=64.0, window_s=10.0, retain=3, clock=clock,
+        overhead_budget=0.01, min_hz=4.0)
+    hz_gauge = tmetrics.REGISTRY.get("mx_profile_hz")
+    adjusts = tmetrics.REGISTRY.get("mx_profile_rate_adjustments_total")
+    down0 = adjusts.labels(direction="down").value
+    try:
+        # Window 1: overhead 5% of 10s wall — way over the 1% budget.
+        profiler._samples_in_window = 20
+        profiler._overhead_in_window = 0.5
+        clock.t = 10.0
+        profiler.rotate()
+        assert profiler.hz == 32.0
+        assert hz_gauge.value == 32.0
+        assert adjusts.labels(direction="down").value == down0 + 1
+        # Still over budget: halves again.
+        profiler._samples_in_window = 20
+        profiler._overhead_in_window = 0.5
+        clock.t = 20.0
+        profiler.rotate()
+        assert profiler.hz == 16.0
+        # Repeatedly over budget: never below min_hz.
+        for i in range(6):
+            profiler._samples_in_window = 20
+            profiler._overhead_in_window = 0.5
+            clock.t = 30.0 + 10.0 * i
+            profiler.rotate()
+        assert profiler.hz == 4.0
+        # Healthy windows (share << budget/4): doubles back, capped at
+        # the configured base rate.
+        for i in range(8):
+            profiler._samples_in_window = 20
+            profiler._overhead_in_window = 0.0001
+            clock.t = 100.0 + 10.0 * i
+            profiler.rotate()
+        assert profiler.hz == 64.0
+        assert profiler.base_hz == 64.0
+        # In the dead band (between budget/4 and budget): no change.
+        profiler._samples_in_window = 20
+        profiler._overhead_in_window = 0.05      # 0.5% of wall
+        clock.t = 200.0
+        profiler.rotate()
+        assert profiler.hz == 64.0
+    finally:
+        profiler.close()
+
+
+def test_adaptive_sampling_disabled_keeps_rate():
+    clock = _FakeClock()
+    profiler = telemetry.ContinuousProfiler(
+        hz=64.0, window_s=10.0, retain=3, clock=clock, adaptive=False)
+    try:
+        profiler._samples_in_window = 20
+        profiler._overhead_in_window = 5.0       # 50% overhead share
+        clock.t = 10.0
+        profiler.rotate()
+        assert profiler.hz == 64.0
+    finally:
+        profiler.close()
