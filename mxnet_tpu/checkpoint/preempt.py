@@ -138,6 +138,11 @@ class PreemptionHook:
                 self._say("mxnet_tpu.checkpoint: snapshot raced the "
                           "step (%r); retrying in %.2fs"
                           % (exc, self.snapshot_retry_delay))
+                # mxlint: disable=signal-safety -- deliberate: CPython
+                # handlers run between bytecodes (not async-signal
+                # context), so the Timer's lock allocation is safe; the
+                # timer re-delivers the signal AFTER the interrupted
+                # statement finishes, which is the whole retry mechanism
                 threading.Timer(self.snapshot_retry_delay, os.kill,
                                 (os.getpid(), signum)).start()
                 return

@@ -93,6 +93,27 @@ CATALOGUE = [
     Knob("MXNET_PS_CC_BUFFER_MB", int, 256, "kvstore_server.py",
          "kvstore server's compile-cache buffer bound (total bytes, "
          "drop-oldest)", False),
+    Knob("MXNET_TPU_PS_HEARTBEAT", float, 5.0, "kvstore_dist.py",
+         "worker->scheduler liveness ping interval in seconds (feeds "
+         "get_dead_nodes)", False),
+    Knob("MXNET_PS_RECONNECT_TIMEOUT", float, 120.0, "kvstore_dist.py",
+         "how long a worker re-queries the scheduler for a restarted "
+         "server's new address before giving up", False),
+    Knob("MXNET_PS_DIAG_BUFFER", int, 16, "kvstore_server.py",
+         "kvstore server's flight-recorder bundle buffer bound (MiB "
+         "total, drop-oldest)", False),
+    Knob("MXNET_USE_NATIVE_RECORDIO", int, 1, "recordio.py",
+         "0 forces the pure-python RecordIO path (escape hatch; "
+         "re-read on every open so a mid-run flip takes effect)", False),
+    Knob("MXNET_HOME", str, "~/.mxnet_tpu", "base.py",
+         "data/model cache root (reference: base.py data_dir)", False),
+    Knob("MXNET_DEVICE", str, "", "examples/, tools/",
+         "driver device pin: auto | cpu | tpu (util.pin_platform). "
+         "Unset = driver-specific: interactive examples auto-detect, "
+         "benchmark/CI drivers pin cpu so they run chip-free", False),
+    Knob("MXNET_TPU_MODEL_ZOO_DIR", str, "", "gluon/model_zoo/",
+         "local directory of pretrained model zoo params (no-download "
+         "model store)", False),
     Knob("DMLC_ROLE", str, "worker", "kvstore_server.py",
          "process role: worker | server | scheduler (set by "
          "tools/launch.py)", False),
@@ -104,6 +125,17 @@ CATALOGUE = [
          "worker count of the dist group", False),
     Knob("DMLC_NUM_SERVER", int, 1, "kvstore_server.py",
          "server count of the dist group", False),
+    Knob("DMLC_NODE_HOST", str, "127.0.0.1", "kvstore_server.py",
+         "address this server/worker advertises to the scheduler "
+         "(multi-host: the host's reachable IP)", False),
+    Knob("DMLC_WORKER_ID", int, 0, "parallel/dist.py",
+         "this process's worker rank (set by tools/launch.py)", False),
+    Knob("DMLC_WORKER_RECOVERY", str, "", "kvstore_dist.py",
+         "set on a restarted worker: rejoin the group as this rank "
+         "instead of rendezvousing fresh", False),
+    Knob("DMLC_SERVER_RECOVERY", str, "", "kvstore_server.py",
+         "set on a restarted server: reload per-key snapshots and "
+         "re-announce through the scheduler", False),
     # -- accepted-but-subsumed (XLA/PJRT owns the mechanism) -----------------
     Knob("MXNET_CPU_WORKER_NTHREADS", int, 1, "(subsumed)",
          "reference engine CPU worker threads; PJRT owns thread pools",

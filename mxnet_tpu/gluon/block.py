@@ -24,6 +24,7 @@ import threading
 import numpy as np
 
 from .. import ndarray as nd
+from ..base import atomic_write
 from ..ndarray.ndarray import NDArray
 from .. import autograd
 from ..cached_op import CachedOp
@@ -428,7 +429,9 @@ class HybridBlock(Block):
         exported = jexport.export(jax.jit(fn))(*xs)
         blob = exported.serialize()
         fname = "%s.stablehlo" % path
-        with open(fname, "wb") as f:
+        # Deployment artifact: a crash mid-serialize must leave the old
+        # export, never a torn .stablehlo a server would then load.
+        with atomic_write(fname, "wb") as f:
             f.write(blob)
         return fname
 

@@ -34,6 +34,7 @@ import zlib
 
 import numpy as np
 
+from .base import atomic_write
 from .context import cpu
 from .kvstore import KVStoreLocal, PullHandle, _key_list, _val_list
 from .kvstore_server import _client
@@ -131,6 +132,9 @@ class KVStoreDist(KVStoreLocal):
         region, kvstore_dist.h:121-123)."""
         with self._sched_lock:
             self._sched.send(("dead_nodes", float(timeout)))
+            # mxlint: disable=lock-blocking -- send+recv is one framed
+            # exchange; the lock exists precisely so replies can't
+            # interleave (ROADMAP "cancellable dist pulls" bounds this)
             reply = self._sched.recv()
         assert reply[0] == "dead_nodes"
         return reply[1]
@@ -177,6 +181,9 @@ class KVStoreDist(KVStoreLocal):
             # one's death.
             with self._sched_lock:
                 self._sched.send(("servers",))
+                # mxlint: disable=lock-blocking -- send+recv is one
+                # framed exchange on the scheduler channel; interleaved
+                # replies would misframe (see class docstring)
                 reply = self._sched.recv()
             assert reply[0] == "servers"
             try:
@@ -217,6 +224,10 @@ class KVStoreDist(KVStoreLocal):
                 conn = self._servers[i]
                 while self._pending_acks[i]:
                     try:
+                        # mxlint: disable=lock-blocking -- ack drain
+                        # holds the comm lock so no other thread can
+                        # send mid-drain and misframe the stream;
+                        # per-server locks are a ROADMAP follow-up
                         reply = conn.recv()
                     except (OSError, EOFError):
                         # Server died with acks in flight; reconnect and
@@ -238,6 +249,10 @@ class KVStoreDist(KVStoreLocal):
                 conn = self._servers[server_idx]
                 try:
                     conn.send(msg)
+                    # mxlint: disable=lock-blocking -- the value RPC's
+                    # send+recv must be one atomic exchange (replies
+                    # carry no request ids); ROADMAP "cancellable dist
+                    # pulls" tracks bounding a dead-peer park here
                     reply = conn.recv()
                     break
                 except (OSError, EOFError, BrokenPipeError):
@@ -603,7 +618,10 @@ class KVStoreDist(KVStoreLocal):
         this for update_on_kvstore)."""
         blobs = [self._call(s, ("get_states",))
                  for s in range(len(self._servers))]
-        with open(fname, "wb") as f:
+        # Durable artifact (resume loads it): commit atomically so a
+        # crash mid-dump can't leave a torn pickle that unpickles as
+        # garbage at restore.
+        with atomic_write(fname, "wb") as f:
             pickle.dump(blobs, f)
 
     def load_optimizer_states(self, fname):
@@ -627,6 +645,10 @@ class KVStoreDist(KVStoreLocal):
         self._drain_acks()
         with self._sched_lock:
             self._sched.send(("barrier",))
+            # mxlint: disable=lock-blocking -- a barrier blocks by
+            # definition; holding the sched channel for the duration is
+            # the documented design (heartbeats pause, the barrier
+            # message itself counts as liveness)
             reply = self._sched.recv()
         if reply[0] != "barrier_done":
             raise RuntimeError(

@@ -51,6 +51,8 @@ import time
 
 import numpy as np
 
+from .base import atomic_write
+
 __all__ = ["KVStoreServer", "Scheduler", "_init_kvstore_server_module"]
 
 _AUTHKEY = os.environ.get("MXNET_TPU_PS_AUTHKEY", "mxnet_tpu_kvstore").encode()
@@ -350,9 +352,12 @@ class KVStoreServer:
 
     @staticmethod
     def _atomic_write(path, blob):
-        with open(path + ".tmp", "wb") as f:
+        # base.atomic_write (mkstemp staging + fsync + rename): a fixed
+        # ".tmp" suffix here let two servers snapshotting the same key
+        # path clobber each other's staging file, and skipping fsync
+        # could commit a rename whose bytes die with the page cache.
+        with atomic_write(path, "wb") as f:
             f.write(blob)
-        os.replace(path + ".tmp", path)
 
     def _write_snapshot(self, key=None):
         """Persist one key's stored value (key given) and, on schedule

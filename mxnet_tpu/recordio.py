@@ -104,6 +104,11 @@ class MXRecordIO:
 
     def open(self):
         if self.flag == "w":
+            # mxlint: disable=atomic-write -- MXRecordIO is a streaming
+            # data-file writer: incremental append IS the API (records
+            # land as write() returns so tools/im2rec.py can tail/resume
+            # mid-pack); durability is the reader-side magic+CRC framing,
+            # not whole-file atomicity
             self.record = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":

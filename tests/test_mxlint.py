@@ -1,0 +1,669 @@
+"""tools/mxlint — the project-aware static analysis suite.
+
+Three layers:
+
+1. Per-checker fixture tests: each rule fires on a seeded violation,
+   stays quiet on the fixed form, and honors a justified suppression.
+2. Regression fixtures reproducing real past bug classes (the pre-PR-6
+   PrefetchingIter joinless worker; a torn non-atomic state dump — the
+   class fixed in PRs 2/5/7/9).
+3. ``test_tree_is_clean``: the full suite over ``mxnet_tpu/`` reports
+   ZERO findings — every invariant the checkers encode is pinned
+   tier-1 from here on.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import run_suite  # noqa: E402
+from tools.mxlint.core import render_json  # noqa: E402
+
+
+def lint(tmp_path, source, checks=None, name="mod.py", root=None):
+    """Write `source` as one module and run the (selected) suite."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    res = run_suite([str(p)], checks=checks, root=str(root or tmp_path))
+    return res
+
+
+def checks_of(res):
+    return [f.check for f in res.findings]
+
+
+# ---------------------------------------------------------------------------
+# lock-blocking / lock-order
+# ---------------------------------------------------------------------------
+
+class TestLockBlocking:
+    def test_sleep_under_with_lock_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading, time
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        time.sleep(1)
+            """, checks=["lock-blocking"])
+        assert checks_of(res) == ["lock-blocking"]
+
+    def test_sleep_outside_lock_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading, time
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        x = 1
+                    time.sleep(1)
+            """, checks=["lock-blocking"])
+        assert res.findings == []
+
+    def test_joinless_join_and_queue_get_under_lock(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading
+            class A:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                def f(self, t, q):
+                    with self._lock:
+                        t.join()
+                        q.get()
+            """, checks=["lock-blocking"])
+        assert checks_of(res) == ["lock-blocking", "lock-blocking"]
+
+    def test_bounded_waits_quiet(self, tmp_path):
+        # timeout'd join/get and block=False are bounded — no finding.
+        res = lint(tmp_path, """
+            import threading
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self, t, q):
+                    with self._lock:
+                        t.join(timeout=5)
+                        q.get(timeout=1)
+                        q.get(block=False)
+            """, checks=["lock-blocking"])
+        assert res.findings == []
+
+    def test_nested_def_resets_held_set(self, tmp_path):
+        # A closure *defined* under the lock runs later, lock-free.
+        res = lint(tmp_path, """
+            import threading, time
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        def worker():
+                            time.sleep(1)
+                        return worker
+            """, checks=["lock-blocking"])
+        assert res.findings == []
+
+    def test_block_until_ready_and_subprocess(self, tmp_path):
+        res = lint(tmp_path, """
+            import subprocess, threading
+            _lock = threading.Lock()
+            def f(x):
+                with _lock:
+                    x.block_until_ready()
+                    subprocess.run(["ls"])          # unbounded: fires
+                    subprocess.run(["ls"], timeout=5)  # bounded: quiet
+            """, checks=["lock-blocking"])
+        assert checks_of(res) == ["lock-blocking", "lock-blocking"]
+
+    def test_lock_order_inversion_across_functions(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading
+            class A:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """, checks=["lock-order"])
+        # Both sites of the inversion are flagged.
+        assert checks_of(res) == ["lock-order", "lock-order"]
+
+    def test_lock_order_is_per_module(self, tmp_path):
+        # 'self._a'/'self._b' in two different files are UNRELATED
+        # locks — no cross-module pairing on bare attribute names.
+        (tmp_path / "m1.py").write_text(textwrap.dedent("""
+            import threading
+            class A:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """))
+        (tmp_path / "m2.py").write_text(textwrap.dedent("""
+            import threading
+            class Unrelated:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """))
+        res = run_suite([str(tmp_path)], checks=["lock-order"],
+                        root=str(tmp_path))
+        assert res.findings == []
+
+    def test_consistent_order_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading
+            class A:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def g(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """, checks=["lock-order"])
+        assert res.findings == []
+
+    def test_suppression_with_justification(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading, time
+            _lock = threading.Lock()
+            def f():
+                with _lock:
+                    time.sleep(1)  # mxlint: disable=lock-blocking -- test fixture
+            """, checks=["lock-blocking"])
+        assert res.findings == [] and res.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# signal-safety
+# ---------------------------------------------------------------------------
+
+class TestSignalSafety:
+    def test_logging_in_handler_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            import logging, signal
+            log = logging.getLogger(__name__)
+            def handler(signum, frame):
+                log.warning("caught %d", signum)
+            def install():
+                signal.signal(signal.SIGTERM, handler)
+            """, checks=["signal-safety"])
+        assert checks_of(res) == ["signal-safety"]
+
+    def test_transitive_reachability(self, tmp_path):
+        # Violation two hops away via self.method chains still found.
+        res = lint(tmp_path, """
+            import signal, threading
+            class H:
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._handler)
+                def _handler(self, signum, frame):
+                    self._helper()
+                def _helper(self):
+                    self._deep()
+                def _deep(self):
+                    open("/tmp/x", "r")
+            """, checks=["signal-safety"])
+        assert checks_of(res) == ["signal-safety"]
+
+    def test_os_write_pattern_quiet(self, tmp_path):
+        # The sanctioned handler vocabulary (os.write, flag sets).
+        res = lint(tmp_path, """
+            import os, signal
+            class H:
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._handler)
+                def _handler(self, signum, frame):
+                    self.fired = True
+                    os.write(2, b"preempted\\n")
+            """, checks=["signal-safety"])
+        assert res.findings == []
+
+    def test_module_level_registration_checked(self, tmp_path):
+        # The most common registration shape: signal.signal at module
+        # level (no enclosing def) — the handler is still checked.
+        res = lint(tmp_path, """
+            import logging, signal
+            log = logging.getLogger(__name__)
+            def handler(signum, frame):
+                log.warning("caught %d", signum)
+            signal.signal(signal.SIGTERM, handler)
+            """, checks=["signal-safety"])
+        assert checks_of(res) == ["signal-safety"]
+
+    def test_same_code_unregistered_quiet(self, tmp_path):
+        # Identical body NOT registered as a handler: no findings.
+        res = lint(tmp_path, """
+            import logging
+            log = logging.getLogger(__name__)
+            def handler(signum, frame):
+                log.warning("caught %d", signum)
+            """, checks=["signal-safety"])
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# atomic-write
+# ---------------------------------------------------------------------------
+
+class TestAtomicWrite:
+    def test_write_mode_open_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            def save(path, blob):
+                with open(path, "wb") as f:
+                    f.write(blob)
+            """, checks=["atomic-write"])
+        assert checks_of(res) == ["atomic-write"]
+
+    def test_read_mode_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            def load(path):
+                with open(path, "rb") as f:
+                    return f.read()
+            def load2(path):
+                return open(path).read()
+            """, checks=["atomic-write"])
+        assert res.findings == []
+
+    def test_append_and_plus_modes_fire(self, tmp_path):
+        res = lint(tmp_path, """
+            def f(path):
+                a = open(path, "ab")
+                b = open(path, "r+")
+            """, checks=["atomic-write"])
+        assert len(res.findings) == 2
+
+    def test_sanctioned_seam_quiet(self, tmp_path):
+        # Same code, but inside the real seam file+function: allowed.
+        d = tmp_path / "mxnet_tpu" / "checkpoint"
+        d.mkdir(parents=True)
+        (tmp_path / "mxnet_tpu" / "env.py").write_text("CATALOGUE = []\n")
+        (d / "manager.py").write_text(textwrap.dedent("""
+            def _open_for_write(path):
+                return open(path, "wb")
+            """))
+        res = run_suite([str(d / "manager.py")], checks=["atomic-write"],
+                        root=str(tmp_path))
+        assert res.findings == []
+
+    def test_regression_torn_state_dump(self, tmp_path):
+        # The bug class fixed in PRs 2/5/7/9 and again this PR
+        # (kvstore_dist.save_optimizer_states): pickle straight into
+        # the destination — a crash mid-dump leaves a torn file that
+        # unpickles as garbage at restore.
+        res = lint(tmp_path, """
+            import pickle
+            def save_optimizer_states(fname, blobs):
+                with open(fname, "wb") as f:
+                    pickle.dump(blobs, f)
+            """, checks=["atomic-write"])
+        assert checks_of(res) == ["atomic-write"]
+
+
+# ---------------------------------------------------------------------------
+# env-knob
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def knob_project(tmp_path):
+    """Mini project: env.py declaring one knob, README documenting it."""
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "env.py").write_text(textwrap.dedent("""
+        from collections import namedtuple
+        Knob = namedtuple("Knob", "name typ default where doc subsumed")
+        CATALOGUE = [
+            Knob("MXNET_DECLARED", int, 1, "x.py", "a knob", False),
+            Knob("MXNET_UNDOCUMENTED", int, 1, "x.py", "hidden", False),
+        ]
+        """))
+    (tmp_path / "README.md").write_text("| `MXNET_DECLARED` | a knob |\n")
+    return tmp_path
+
+
+class TestEnvKnob:
+    def test_undeclared_read_fires(self, knob_project):
+        res = lint(knob_project, """
+            import os
+            x = os.environ.get("MXNET_NOT_DECLARED", "0")
+            """, checks=["env-knob"], root=knob_project)
+        assert checks_of(res) == ["env-knob"]
+        assert "MXNET_NOT_DECLARED" in res.findings[0].message
+
+    def test_declared_read_quiet(self, knob_project):
+        res = lint(knob_project, """
+            import os
+            x = os.environ.get("MXNET_DECLARED", "0")
+            y = os.environ["MXNET_DECLARED"]
+            z = os.getenv("MXNET_DECLARED")
+            """, checks=["env-knob"], root=knob_project)
+        assert res.findings == []
+
+    def test_typo_is_caught(self, knob_project):
+        # The motivating failure: a typo silently reads its default.
+        res = lint(knob_project, """
+            import os
+            x = os.environ.get("MXNET_DECLRED", "0")
+            """, checks=["env-knob"], root=knob_project)
+        assert len(res.findings) == 1
+
+    def test_catalogue_entry_missing_from_readme(self, knob_project):
+        env_py = knob_project / "mxnet_tpu" / "env.py"
+        res = run_suite([str(env_py)], checks=["env-knob"],
+                        root=str(knob_project))
+        assert len(res.findings) == 1
+        assert "MXNET_UNDOCUMENTED" in res.findings[0].message
+
+    def test_dynamic_read_out_of_scope(self, knob_project):
+        res = lint(knob_project, """
+            import os
+            def probe(name):
+                return os.environ.get(name)
+            """, checks=["env-knob"], root=knob_project)
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+class TestThreadLifecycle:
+    def test_regression_pre_pr6_prefetching_iter(self, tmp_path):
+        # The real pre-PR-6 shape: non-daemon workers started with no
+        # join path — wedged interpreter at exit, swallowed errors.
+        res = lint(tmp_path, """
+            import threading
+            class PrefetchingIter:
+                def __init__(self, n):
+                    self.threads = []
+                    for i in range(n):
+                        t = threading.Thread(target=self._worker)
+                        t.start()
+                        self.threads.append(t)
+                def _worker(self):
+                    pass
+            """, checks=["thread-lifecycle"])
+        assert checks_of(res) == ["thread-lifecycle"]
+
+    def test_daemon_kwarg_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading
+            threading.Thread(target=print, daemon=True).start()
+            """, checks=["thread-lifecycle"])
+        assert res.findings == []
+
+    def test_daemon_attr_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading
+            def go():
+                t = threading.Thread(target=print)
+                t.daemon = True
+                t.start()
+            """, checks=["thread-lifecycle"])
+        assert res.findings == []
+
+    def test_join_path_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            import threading
+            class W:
+                def start(self):
+                    self._thread = threading.Thread(target=self._run)
+                    self._thread.start()
+                def close(self):
+                    self._thread.join(timeout=5)
+                def _run(self):
+                    pass
+            """, checks=["thread-lifecycle"])
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# telemetry-naming
+# ---------------------------------------------------------------------------
+
+class TestTelemetryNaming:
+    def test_bad_family_prefix_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.telemetry import metrics
+            c = metrics.REGISTRY.counter("train_steps_total", "steps")
+            """, checks=["telemetry-naming"])
+        assert checks_of(res) == ["telemetry-naming"]
+
+    def test_good_family_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.telemetry import metrics
+            c = metrics.REGISTRY.counter("mx_train_steps_total", "steps")
+            """, checks=["telemetry-naming"])
+        assert res.findings == []
+
+    def test_bare_span_name_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.telemetry import trace
+            def step():
+                with trace.span("step"):
+                    pass
+            """, checks=["telemetry-naming"])
+        assert checks_of(res) == ["telemetry-naming"]
+
+    def test_span_format_template_followed(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.telemetry import trace
+            def f(i):
+                with trace.span("serving::bucket_%d" % i):
+                    pass
+                with trace.span("bucket_%d" % i):
+                    pass
+            """, checks=["telemetry-naming"])
+        assert len(res.findings) == 1
+
+    def test_conflicting_label_sets_fire(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.telemetry import metrics
+            a = metrics.REGISTRY.counter("mx_foo_total", "x", labels=("site",))
+            b = metrics.REGISTRY.counter("mx_foo_total", "x", labels=("rank",))
+            """, checks=["telemetry-naming"])
+        assert checks_of(res) == ["telemetry-naming"]
+        assert "label" in res.findings[0].message
+
+    def test_omitted_labels_is_empty_label_set(self, tmp_path):
+        # The real API defaults labels=(): omitting it still conflicts
+        # with a labeled registration of the same family.
+        res = lint(tmp_path, """
+            from mxnet_tpu.telemetry import metrics
+            a = metrics.REGISTRY.counter("mx_foo_total", "x")
+            b = metrics.REGISTRY.counter("mx_foo_total", "x", labels=("rank",))
+            """, checks=["telemetry-naming"])
+        assert checks_of(res) == ["telemetry-naming"]
+
+    def test_same_label_set_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.telemetry import metrics
+            a = metrics.REGISTRY.counter("mx_foo_total", "x", labels=("site",))
+            b = metrics.REGISTRY.counter("mx_foo_total", "x", labels=("site",))
+            """, checks=["telemetry-naming"])
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    def test_unjustified_suppression_is_a_finding(self, tmp_path):
+        res = lint(tmp_path, """
+            def save(path, blob):
+                f = open(path, "wb")  # mxlint: disable=atomic-write
+            """, checks=["atomic-write"])
+        assert checks_of(res) == ["bad-suppression"]
+
+    def test_next_line_comment_form(self, tmp_path):
+        res = lint(tmp_path, """
+            def save(path, blob):
+                # mxlint: disable=atomic-write -- streaming writer,
+                # append semantics are the API
+                f = open(path, "wb")
+            """, checks=["atomic-write"])
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_wrong_check_name_does_not_suppress(self, tmp_path):
+        res = lint(tmp_path, """
+            def save(path, blob):
+                f = open(path, "wb")  # mxlint: disable=lock-blocking -- nope
+            """, checks=["atomic-write"])
+        assert checks_of(res) == ["atomic-write"]
+
+    def test_stacked_suppression_comments_merge(self, tmp_path):
+        # Two whole-line disables for the same next code line: both
+        # apply (neither silently shadows the other).
+        res = lint(tmp_path, """
+            import threading, time
+            _lock = threading.Lock()
+            def f(path):
+                with _lock:
+                    # mxlint: disable=lock-blocking -- fixture
+                    # mxlint: disable=atomic-write -- fixture
+                    open(path, "wb") and time.sleep(1)
+            """, checks=["atomic-write", "lock-blocking"])
+        assert res.findings == [] and res.suppressed == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI + tree gate
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def _run(self, *args, cwd=REPO):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.mxlint", *args],
+            cwd=cwd, capture_output=True, text=True, timeout=120)
+
+    def test_json_output_stable_and_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text('f = open("x", "wb")\n')
+        proc = self._run("--format=json", str(bad))
+        assert proc.returncode == 1
+        out = json.loads(proc.stdout)
+        assert out["version"] == 1
+        assert out["counts"] == {"atomic-write": 1}
+        assert [f["check"] for f in out["findings"]] == ["atomic-write"]
+        # Byte-stable across runs (bench --compare-style diffing).
+        assert proc.stdout == self._run("--format=json", str(bad)).stdout
+
+    def test_check_subset_and_unknown_check(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text('f = open("x", "wb")\n')
+        assert self._run("--check=thread-lifecycle",
+                         str(bad)).returncode == 0
+        assert self._run("--check=nonsense", str(bad)).returncode == 2
+
+    def test_check_subset_filters_secondary_kinds(self, tmp_path):
+        # --check=lock-blocking must not report lock-order findings.
+        p = tmp_path / "inv.py"
+        p.write_text(textwrap.dedent("""
+            import threading
+            class A:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """))
+        res = run_suite([str(p)], checks=["lock-blocking"],
+                        root=str(tmp_path))
+        assert res.findings == []
+
+    def test_zero_files_is_loud(self, tmp_path):
+        # A clean report that analyzed nothing must not exit 0 (wrong
+        # cwd would otherwise green-light CI forever).
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        proc = self._run(str(empty))
+        assert proc.returncode == 2
+        assert "no .py files" in proc.stderr
+
+    def test_relative_project_root_still_checks_catalogue(self, tmp_path):
+        # A RELATIVE --project-root must not silently skip the env.py
+        # catalogue-vs-README check (abspath normalization): seed an
+        # undocumented knob and demand the finding surfaces.
+        pkg = tmp_path / "mxnet_tpu"
+        pkg.mkdir()
+        (pkg / "env.py").write_text(textwrap.dedent("""
+            from collections import namedtuple
+            Knob = namedtuple("Knob", "name typ default where doc subsumed")
+            CATALOGUE = [Knob("MXNET_HIDDEN", int, 1, "x", "d", False)]
+            """))
+        # One unrelated knob token: an entirely token-free README reads
+        # as "no env table yet" and skips the check by design.
+        (tmp_path / "README.md").write_text("| `MXNET_OTHER` | x |\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.mxlint", "--project-root=.",
+             "mxnet_tpu/env.py"],
+            cwd=str(tmp_path), capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "MXNET_HIDDEN" in proc.stdout
+
+    def test_syntax_error_reported_not_crash(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        proc = self._run(str(bad))
+        assert proc.returncode == 1
+        assert "parse-error" in proc.stdout
+
+
+def test_render_json_sorted(tmp_path):
+    (tmp_path / "b.py").write_text('f = open("x", "wb")\n')
+    (tmp_path / "a.py").write_text('g = open("y", "wb")\n')
+    res = run_suite([str(tmp_path)], checks=["atomic-write"],
+                    root=str(tmp_path))
+    paths = [f.path for f in res.findings]
+    assert paths == sorted(paths)
+    json.loads(render_json(res))  # valid JSON
+
+
+def test_tree_is_clean():
+    """The tier-1 gate: the full suite over mxnet_tpu/ is ZERO findings.
+
+    A finding here is a real invariant violation (or a new intentional
+    pattern needing a justified `# mxlint: disable=<check> -- why`
+    suppression) — run `python -m tools.mxlint mxnet_tpu/` for the
+    annotated report.
+    """
+    res = run_suite([os.path.join(REPO, "mxnet_tpu")], root=REPO)
+    msgs = ["%s:%d: [%s] %s" % (f.path, f.line, f.check, f.message)
+            for f in res.findings]
+    assert not msgs, "mxlint findings on the tree:\n" + "\n".join(msgs)
+    assert not res.errors, res.errors
+    assert res.files > 150  # the walk actually covered the tree

@@ -1,0 +1,41 @@
+"""mxlint — project-aware static analysis for mxnet_tpu.
+
+Six AST-based checkers (stdlib only), each machine-checking an
+invariant a past regression taught us to enforce::
+
+    python -m tools.mxlint mxnet_tpu/                 # full suite
+    python -m tools.mxlint --format=json mxnet_tpu/   # stable JSON
+    python -m tools.mxlint --check=atomic-write path/ # one rule
+
+Exit 0 = clean, 1 = findings, 2 = usage error. Tier-1 pins the tree
+clean (tests/test_mxlint.py::test_tree_is_clean). Suppress a finding
+on its line with a REQUIRED justification::
+
+    f = open(p, "wb")  # mxlint: disable=atomic-write -- <why safe>
+"""
+from .core import Finding, run, render_json, render_text
+from .checkers import ALL_CHECKERS, CHECKS
+
+__all__ = ["Finding", "run", "render_json", "render_text",
+           "ALL_CHECKERS", "CHECKS", "run_suite"]
+
+
+def run_suite(paths, checks=None, root=None):
+    """Programmatic entry: run the (selected) suite, return RunResult."""
+    if checks:
+        classes = []
+        for c in checks:
+            if c not in CHECKS:
+                raise ValueError("unknown check %r (known: %s)"
+                                 % (c, ", ".join(sorted(CHECKS))))
+            if CHECKS[c] not in classes:
+                classes.append(CHECKS[c])
+    else:
+        classes = list(ALL_CHECKERS)
+    result = run(paths, [cls() for cls in classes], root=root)
+    if checks:
+        # A checker class may emit several finding kinds (lock-order
+        # rides LockChecker): report only the kinds asked for.
+        keep = set(checks) | {"bad-suppression"}
+        result.findings = [f for f in result.findings if f.check in keep]
+    return result

@@ -1,0 +1,58 @@
+"""CLI: ``python -m tools.mxlint [options] paths...``"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import ALL_CHECKERS, CHECKS, run_suite
+from .core import render_json, render_text
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="Project-aware static analysis for mxnet_tpu.")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files/directories to analyze "
+                             "(default: mxnet_tpu/)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--check", default="",
+                        help="comma-separated subset of checks to run "
+                             "(default: all)")
+    parser.add_argument("--list-checks", action="store_true",
+                        help="list available checks and exit")
+    parser.add_argument("--project-root", default=None,
+                        help="repo root (default: walk up to find "
+                             "mxnet_tpu/env.py)")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for cls in ALL_CHECKERS:
+            print("%-18s %s" % (cls.name, cls.description))
+        extra = sorted(set(CHECKS) - {c.name for c in ALL_CHECKERS})
+        for name in extra:
+            print("%-18s (secondary kind of %s)"
+                  % (name, CHECKS[name].name))
+        return 0
+
+    paths = args.paths or ["mxnet_tpu"]
+    checks = [c.strip() for c in args.check.split(",") if c.strip()]
+    try:
+        result = run_suite(paths, checks or None, root=args.project_root)
+    except ValueError as exc:
+        print("mxlint: %s" % exc, file=sys.stderr)
+        return 2
+    if result.files == 0 and not result.errors:
+        # A clean report that analyzed nothing is a lie a wrong cwd
+        # would tell forever — make it loud.
+        print("mxlint: no .py files found under %r" % (paths,),
+              file=sys.stderr)
+        return 2
+    render = render_json if args.format == "json" else render_text
+    print(render(result))
+    return 1 if (result.findings or result.errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Small AST helpers shared by the mxlint checkers."""
+from __future__ import annotations
+
+import ast
+
+__all__ = ["dotted", "expr_token", "str_arg", "kwarg", "func_defs",
+           "FunctionIndex"]
+
+
+def dotted(node):
+    """Render a Name/Attribute chain as 'a.b.c' (None if not a chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def expr_token(node):
+    """Stable textual token for a lock/queue/thread expression.
+
+    'self._lock', 'lock', 'cls._mu' — anything else (calls, subscripts)
+    returns None: such expressions have no cross-statement identity.
+    """
+    return dotted(node)
+
+
+def str_arg(node):
+    """First-arg string literal of a call, following '%'-format and
+    '.format' through to the literal template (so
+    ``span("serving::bucket_%d" % i)`` still yields the template)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        return str_arg(node.left)
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "format"):
+        return str_arg(node.func.value)
+    return None
+
+
+def kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def func_defs(tree):
+    """Yield every (def-node, enclosing-class-name-or-None) in a module."""
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from walk(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name)
+            else:
+                yield from walk(child, cls)
+    yield from walk(tree, None)
+
+
+class FunctionIndex:
+    """Module-level call-graph index: resolve 'name' / 'self.name' calls
+    to def nodes so checkers can do bounded reachability walks."""
+
+    def __init__(self, tree):
+        self.module_fns = {}          # name -> def node (module level)
+        self.methods = {}             # (class, name) -> def node
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_fns[node.name] = node
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.methods[(node.name, item.name)] = item
+
+    def resolve(self, call, cls):
+        """Resolve a Call's callee to a def node in this module, if the
+        reference is statically unambiguous (bare name, or self.method
+        within class `cls`)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.module_fns.get(f.id), cls
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and cls is not None):
+            m = self.methods.get((cls, f.attr))
+            if m is not None:
+                return m, cls
+        return None, None

@@ -1,0 +1,37 @@
+"""mxlint checker registry.
+
+Each checker encodes one invariant this codebase already relies on;
+adding a checker = subclass :class:`tools.mxlint.core.Checker`, give it
+a ``name``/``description``, and list it here (README "Static analysis"
+documents the how-to).
+"""
+from .envknobs import EnvKnobChecker
+from .locks import LockChecker
+from .signals import SignalChecker
+from .telemetry_names import TelemetryNameChecker
+from .threads import ThreadChecker
+from .writes import WriteChecker
+
+# Construction order == report/documentation order.
+ALL_CHECKERS = (
+    LockChecker,
+    SignalChecker,
+    WriteChecker,
+    EnvKnobChecker,
+    ThreadChecker,
+    TelemetryNameChecker,
+)
+
+# Selectable names (--check=...): a checker may emit secondary finding
+# kinds (lock-order rides LockChecker); map both to their class.
+CHECKS = {
+    "lock-blocking": LockChecker,
+    "lock-order": LockChecker,
+    "signal-safety": SignalChecker,
+    "atomic-write": WriteChecker,
+    "env-knob": EnvKnobChecker,
+    "thread-lifecycle": ThreadChecker,
+    "telemetry-naming": TelemetryNameChecker,
+}
+
+__all__ = ["ALL_CHECKERS", "CHECKS"]

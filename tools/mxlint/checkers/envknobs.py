@@ -1,0 +1,80 @@
+"""Checker: env-knob registry discipline.
+
+Every ``MXNET_*`` / ``DMLC_*`` environment read must be declared in
+``mxnet_tpu/env.py``'s CATALOGUE and documented in the README env
+table. The failure modes this kills: a typo'd knob name that silently
+reads its default forever, and an undocumented knob an operator can't
+discover (`env.describe()` and the flight-recorder env section both
+render only the catalogue — an uncatalogued knob is invisible to
+forensics too).
+
+Read sites recognized: ``os.environ.get("MXNET_X")``,
+``os.environ["MXNET_X"]``, ``os.getenv``, ``env.get``/``get_env`` — any
+call/subscript whose string literal names a knob. ``env.py`` itself
+(the declarations) and dynamic reads (name built at runtime) are out of
+scope by construction.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import dotted
+from ..core import Checker, Finding
+
+_KNOB = re.compile(r"^(MXNET|DMLC)_[A-Z0-9_]+$")
+_READERS = {"get", "getenv", "get_env", "pop", "setdefault"}
+
+
+class EnvKnobChecker(Checker):
+    name = "env-knob"
+    description = ("every MXNET_*/DMLC_* env read declared in env.py's "
+                   "CATALOGUE and documented in the README env table")
+
+    def begin_project(self, ctx):
+        self._ctx = ctx
+
+    def check_module(self, mod):
+        if self._ctx.env_py and mod.abspath == self._ctx.env_py:
+            return self._check_catalogue(mod)
+        findings = []
+        for node in ast.walk(mod.tree):
+            for name, line in self._knob_reads(node):
+                if name not in self._ctx.catalogue:
+                    findings.append(Finding(
+                        mod.relpath, line, self.name,
+                        "env knob %r read here is not declared in "
+                        "mxnet_tpu/env.py CATALOGUE — typos read their "
+                        "default forever and operators can't discover "
+                        "it" % name))
+        return findings
+
+    def _knob_reads(self, node):
+        """Yield (knob-name, line) for env-read call/subscript nodes."""
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            if name.split(".")[-1] in _READERS and node.args:
+                a = node.args[0]
+                if (isinstance(a, ast.Constant) and isinstance(a.value, str)
+                        and _KNOB.match(a.value)):
+                    yield a.value, node.lineno
+        elif isinstance(node, ast.Subscript):
+            base = dotted(node.value) or ""
+            s = node.slice
+            if (base.endswith("environ") and isinstance(s, ast.Constant)
+                    and isinstance(s.value, str) and _KNOB.match(s.value)):
+                yield s.value, node.lineno
+
+    def _check_catalogue(self, mod):
+        """On env.py itself: every declared knob must appear in the
+        README env documentation."""
+        findings = []
+        if not self._ctx.readme_names:
+            return findings
+        for name, line in sorted(self._ctx.catalogue_lines.items()):
+            if name not in self._ctx.readme_names:
+                findings.append(Finding(
+                    mod.relpath, line, self.name,
+                    "knob %r is declared in CATALOGUE but missing from "
+                    "the README env table — document it" % name))
+        return findings

@@ -1,0 +1,206 @@
+"""Checker: blocking-under-lock + inconsistent two-lock ordering.
+
+The deadlock class this encodes: the kvstore comm lock serializes wire
+framing while a dedicated puller thread parks in sync pulls, and the
+trainer comm thread queues work the main thread joins on — any
+unbounded wait taken *while holding* one of these locks turns a slow
+peer into a wedged pod (the hang watchdog then fires, but the lint
+catches it before it ships). Flagged while a ``threading.Lock/RLock``
+is held:
+
+- ``time.sleep(...)``
+- ``x.join()`` / ``x.wait()`` with no timeout (thread/event waits)
+- ``q.get()`` with no timeout (queue parks; ``block=False`` is fine)
+- ``subprocess.*`` calls with no ``timeout=`` (bounded runs are fine)
+  and blocking socket ops (accept/recv/connect)
+- ``.block_until_ready()`` (device sync can wait on a collective whose
+  peers need this very lock)
+
+Separately, ``lock-order``: if one function nests lock A inside lock B
+and another nests B inside A, the pair deadlocks under concurrency —
+both sites are flagged.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import dotted, expr_token, kwarg
+from ..core import Checker, Finding
+
+_LOCK_CTOR = re.compile(r"(^|\.)(Lock|RLock)$")
+_QUEUE_CTOR = re.compile(r"(^|\.)(Queue|LifoQueue|PriorityQueue|"
+                         r"SimpleQueue)$")
+_LOCKISH_NAME = re.compile(r"(^|_)(lock|mutex|mu)$", re.I)
+_QUEUEISH_NAME = re.compile(r"(^|_)(q|queue)$", re.I)
+_SOCKET_BLOCKING = {"accept", "recv", "recvfrom", "recv_into", "connect",
+                    "sendall"}
+
+
+def _collect_tokens(tree, ctor_re):
+    """Tokens ('self._lock', 'lock') assigned from a matching ctor."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = dotted(node.value.func)
+            if name and ctor_re.search(name):
+                for tgt in node.targets:
+                    tok = expr_token(tgt)
+                    if tok:
+                        out.add(tok)
+    return out
+
+
+class LockChecker(Checker):
+    name = "lock-blocking"
+    description = ("no unbounded blocking calls while holding a "
+                   "threading.Lock/RLock; consistent two-lock ordering")
+
+    def check_module(self, mod):
+        # Lock-order state is per-module: tokens like 'self._lock' have
+        # no identity across files (two unrelated classes may both name
+        # a lock '_mu'); the cross-module lock-order graph is a ROADMAP
+        # follow-up.
+        self._order = {}   # (lockA, lockB) -> (relpath, line) first seen
+        locks = _collect_tokens(mod.tree, _LOCK_CTOR)
+        queues = _collect_tokens(mod.tree, _QUEUE_CTOR)
+        self._findings = []
+        for node in mod.tree.body:
+            self._walk_stmts([node], mod, locks, queues, held=[])
+        return self._findings
+
+    # -- lock-region tracking -------------------------------------------------
+
+    def _is_lock(self, tok, locks):
+        if tok is None:
+            return False
+        return tok in locks or bool(_LOCKISH_NAME.search(tok.split(".")[-1]))
+
+    def _is_queue(self, tok, queues):
+        if tok is None:
+            return False
+        return (tok in queues
+                or bool(_QUEUEISH_NAME.search(tok.split(".")[-1])))
+
+    def _walk_stmts(self, stmts, mod, locks, queues, held):
+        """Statement-ordered walk tracking the held-lock stack.
+
+        Handles ``with lock:`` regions plus the linear
+        ``x.acquire()`` ... ``x.release()`` pattern within one suite.
+        """
+        acquired_here = []
+        for stmt in stmts:
+            # x.acquire() / x.release() as bare statements.
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                f = stmt.value.func
+                if isinstance(f, ast.Attribute):
+                    tok = expr_token(f.value)
+                    if f.attr == "acquire" and self._is_lock(tok, locks):
+                        self._note_order(mod, held, tok, stmt)
+                        held = held + [tok]
+                        acquired_here.append(tok)
+                        continue
+                    if f.attr == "release" and tok in held:
+                        held = [t for t in held if t != tok]
+                        if tok in acquired_here:
+                            acquired_here.remove(tok)
+                        continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = list(held)
+                body_locks = []
+                for item in stmt.items:
+                    tok = expr_token(item.context_expr)
+                    if self._is_lock(tok, locks):
+                        self._note_order(mod, inner, tok, stmt)
+                        inner = inner + [tok]
+                        body_locks.append(tok)
+                    else:
+                        self._scan_expr(item.context_expr, mod, held, queues)
+                self._walk_stmts(stmt.body, mod, locks, queues, inner)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A nested def is a new execution context: the enclosing
+                # lock is NOT held when its body eventually runs.
+                self._walk_stmts(stmt.body, mod, locks, queues, held=[])
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                self._walk_stmts(stmt.body, mod, locks, queues, held=[])
+                continue
+            # Generic statement: scan its expressions under the current
+            # held set, then recurse into sub-suites.
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child, mod, held, queues)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub and isinstance(sub[0], ast.stmt):
+                    self._walk_stmts(sub, mod, locks, queues, held)
+            for handler in getattr(stmt, "handlers", []):
+                self._walk_stmts(handler.body, mod, locks, queues, held)
+        return held
+
+    def _scan_expr(self, expr, mod, held, queues):
+        if not held:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                msg = self._blocking_reason(node, queues)
+                if msg:
+                    self._findings.append(Finding(
+                        mod.relpath, node.lineno, self.name,
+                        "%s while holding lock %r — an unbounded wait "
+                        "here wedges every thread contending for it"
+                        % (msg, held[-1])))
+
+    def _blocking_reason(self, call, queues):
+        f = call.func
+        name = dotted(f) or ""
+        last = name.split(".")[-1]
+        if last == "sleep" and (name.startswith("time.")
+                                or name in ("sleep", "_time.sleep")):
+            return "time.sleep()"
+        if name.startswith("subprocess.") and kwarg(call, "timeout") is None:
+            return "subprocess call %s()" % name
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = expr_token(f.value)
+        timeout = kwarg(call, "timeout")
+        if f.attr in ("join", "wait") and not call.args and timeout is None:
+            return "no-timeout .%s()" % f.attr
+        if (f.attr == "get" and not call.args and timeout is None
+                and self._is_queue(recv, queues)):
+            blk = kwarg(call, "block")
+            if not (isinstance(blk, ast.Constant) and blk.value is False):
+                return "blocking queue .get()"
+        if f.attr in _SOCKET_BLOCKING and recv is not None:
+            low = recv.split(".")[-1].lower()
+            if ("sock" in low or "conn" in low or "listener" in low
+                    or "sched" in low):
+                return "blocking socket .%s()" % f.attr
+        if f.attr == "block_until_ready":
+            return ".block_until_ready()"
+        return None
+
+    # -- cross-function lock ordering -----------------------------------------
+
+    def _note_order(self, mod, held, new, stmt):
+        for outer in held:
+            if outer == new:
+                continue
+            key = (outer, new)
+            rev = (new, outer)
+            if rev in self._order:
+                where = self._order[rev]
+                # Flag BOTH sites: either ordering may be the wrong one.
+                self._findings.append(Finding(
+                    mod.relpath, stmt.lineno, "lock-order",
+                    "acquires %r then %r, but %s:%d acquires them in the "
+                    "opposite order — this pair can deadlock"
+                    % (outer, new, where[0], where[1])))
+                self._findings.append(Finding(
+                    where[0], where[1], "lock-order",
+                    "acquires %r then %r, but %s:%d acquires them in the "
+                    "opposite order — this pair can deadlock"
+                    % (new, outer, mod.relpath, stmt.lineno)))
+            else:
+                self._order.setdefault(key, (mod.relpath, stmt.lineno))

@@ -1,0 +1,134 @@
+"""Checker: signal-handler safety (the PR 2 preempt contract).
+
+A Python signal handler runs on the main thread *wherever the signal
+interrupted it* — possibly inside a logging call holding the logging
+module's lock, or mid-allocation. Functions reachable from a
+``signal.signal(sig, handler)`` registration therefore must not:
+
+- log (``logging.*`` / ``logger.*`` / ``print``) — the interrupted
+  frame may hold the logging lock; re-entering deadlocks,
+- ``open()`` files — buffered IO takes locks and can re-enter the
+  allocator,
+- allocate ``threading`` primitives (Lock/RLock/Condition/Event/
+  Semaphore/Timer/Thread) or ``queue.Queue`` — each allocates locks.
+
+``os.write(2, ...)`` is the sanctioned way to speak from a handler
+(checkpoint/preempt.py's ``_say``). Reachability follows bare-name and
+``self.method`` calls within the registering module (statically
+resolvable edges only), to a bounded depth.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import FunctionIndex, dotted
+from ..core import Checker, Finding
+
+_THREADING_ALLOC = re.compile(
+    r"(^|\.)(Lock|RLock|Condition|Event|Semaphore|BoundedSemaphore|"
+    r"Barrier|Timer|Thread)$")
+_QUEUE_ALLOC = re.compile(r"^(queue|_queue|Queue)\.(Queue|LifoQueue|"
+                          r"PriorityQueue|SimpleQueue)$|^Queue$")
+_LOGGERISH = re.compile(r"(^|_)(log|logger|logging)$", re.I)
+_MAX_DEPTH = 6
+
+
+class SignalChecker(Checker):
+    name = "signal-safety"
+    description = ("functions reachable from signal.signal registrations "
+                   "must not log, open files, or allocate locks")
+
+    def check_module(self, mod):
+        findings = []
+        index = FunctionIndex(mod.tree)
+        handlers = self._registered_handlers(mod, index)
+        seen = set()
+        frontier = [(fn, cls, chain, 0) for fn, cls, chain in handlers]
+        while frontier:
+            fn, cls, chain, depth = frontier.pop()
+            if id(fn) in seen or depth > _MAX_DEPTH:
+                continue
+            seen.add(id(fn))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._unsafe_reason(node)
+                if msg:
+                    findings.append(Finding(
+                        mod.relpath, node.lineno, self.name,
+                        "%s in %s (reachable from signal handler %s) — "
+                        "the interrupted frame may already hold the "
+                        "locks this takes" % (msg, fn.name, chain)))
+                callee, ccls = index.resolve(node, cls)
+                if callee is not None:
+                    frontier.append((callee, ccls,
+                                     chain + "->" + callee.name, depth + 1))
+        return findings
+
+    def _registered_handlers(self, mod, index):
+        """(def-node, class, chain-label) for every signal.signal(sig, h)
+        whose handler resolves to a function in this module — including
+        registrations made at module level (outside any def)."""
+        out = []
+        # Bare-name handlers: anywhere in the module, module level
+        # included (the most common registration shape).
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and dotted(node.func) in ("signal.signal",
+                                              "_signal.signal")
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Name)):
+                resolved = index.module_fns.get(node.args[1].id)
+                if resolved is not None:
+                    out.append((resolved, None, resolved.name))
+        # self.method handlers need the enclosing class for resolution.
+        for fn, cls in self._defs(mod.tree):
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and dotted(node.func) in ("signal.signal",
+                                                  "_signal.signal")
+                        and len(node.args) >= 2):
+                    continue
+                target = node.args[1]
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self" and cls):
+                    resolved = index.methods.get((cls, target.attr))
+                    if resolved is not None:
+                        out.append((resolved, cls, resolved.name))
+        return out
+
+    @staticmethod
+    def _defs(tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        yield item, node.name
+            elif isinstance(node, ast.Module):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        yield item, None
+
+    def _unsafe_reason(self, call):
+        name = dotted(call.func) or ""
+        if name == "print":
+            return "print()"
+        if name == "open":
+            return "open()"
+        parts = name.split(".")
+        if len(parts) >= 2 and _LOGGERISH.search(parts[-2]):
+            return "logging call %s()" % name
+        if name.startswith("logging."):
+            return "logging call %s()" % name
+        if _THREADING_ALLOC.search(name) and (
+                name.startswith(("threading.", "_threading."))
+                or name in ("Lock", "RLock", "Condition", "Event",
+                            "Semaphore", "Timer", "Thread")):
+            return "allocation %s()" % name
+        if _QUEUE_ALLOC.match(name):
+            return "allocation %s()" % name
+        return None

@@ -1,0 +1,107 @@
+"""Checker: telemetry naming conventions.
+
+The observability plane is only queryable because its names are
+uniform: metric families are ``mx_<subsystem>_<what>`` (the fleet
+aggregator's ``sum without (rank)`` and the bench ``--compare`` differ
+key on exact family names), and trace spans are ``subsystem::name``
+(trace_merge, the flamegraph tooling, and the span-id exemplar links
+all split on ``::``). Enforced:
+
+- family names passed to ``*.counter/gauge/histogram(...)`` match
+  ``mx_[a-z0-9_]+``,
+- span names passed to ``*.span(...)`` carry a ``subsystem::`` prefix
+  (format templates are followed: ``span("serving::bucket_%d" % n)``
+  checks the template),
+- one family name is registered with ONE label set — re-registering
+  ``mx_foo`` with different labels silently splits the family across
+  registries and the aggregator merge drops one side (cross-module,
+  checked at finalize).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import dotted, str_arg
+from ..core import Checker, Finding
+
+_FAMILY_RE = re.compile(r"^mx_[a-z0-9_]+$")
+_SPAN_RE = re.compile(r"^[a-z0-9_]+::")
+_FAMILY_METHODS = {"counter", "gauge", "histogram"}
+
+
+class TelemetryNameChecker(Checker):
+    name = "telemetry-naming"
+    description = ("metric families are mx_*, spans are subsystem::name, "
+                   "no family re-registered with a different label set")
+
+    def begin_project(self, ctx):
+        self._families = {}   # name -> (labels tuple | None, path, line)
+        self._findings = []
+
+    def check_module(self, mod):
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func) or ""
+            tail = callee.split(".")[-1]
+            if tail in _FAMILY_METHODS and node.args:
+                fam = str_arg(node.args[0])
+                if fam is None:
+                    continue
+                if not _FAMILY_RE.match(fam):
+                    findings.append(Finding(
+                        mod.relpath, node.lineno, self.name,
+                        "metric family %r does not match mx_[a-z0-9_]+ — "
+                        "fleet aggregation and bench --compare key on "
+                        "the mx_ namespace" % fam))
+                else:
+                    self._note_family(fam, node, mod)
+            elif tail == "span" and node.args:
+                span = str_arg(node.args[0])
+                if span is not None and not _SPAN_RE.match(span):
+                    findings.append(Finding(
+                        mod.relpath, node.lineno, self.name,
+                        "span name %r lacks the 'subsystem::' prefix — "
+                        "trace_merge and the flamegraph tools split on "
+                        "'::'" % span))
+        return findings
+
+    def _note_family(self, fam, call, mod):
+        # The real API defaults labels=() — an omitted labels argument
+        # IS a label-set declaration, so ()-vs-('rank',) splits are
+        # caught too. Only a non-literal labels expression is opaque.
+        labels = ()
+        for kw in call.keywords:
+            if kw.arg == "labels":
+                labels = self._literal_labels(kw.value)
+        if len(call.args) >= 3:
+            labels = self._literal_labels(call.args[2])
+        if labels is None:
+            return
+        prev = self._families.get(fam)
+        if prev is None:
+            self._families[fam] = (labels, mod.relpath, call.lineno)
+        elif prev[0] != labels:
+            self._findings.append(Finding(
+                mod.relpath, call.lineno, self.name,
+                "family %r re-registered with labels %r but %s:%d "
+                "registered it with %r — conflicting label sets split "
+                "the family" % (fam, list(labels), prev[1], prev[2],
+                                list(prev[0]))))
+
+    @staticmethod
+    def _literal_labels(node):
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = []
+            for el in node.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)):
+                    return None
+                vals.append(el.value)
+            return tuple(vals)
+        return None
+
+    def finalize(self):
+        return self._findings

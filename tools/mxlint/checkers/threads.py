@@ -1,0 +1,82 @@
+"""Checker: thread lifecycle.
+
+Every started ``threading.Thread`` needs exactly one of:
+
+- ``daemon=True`` at construction (or ``t.daemon = True`` before
+  start) — an explicit declaration that the thread may be killed at
+  interpreter exit, or
+- a reachable ``.join()`` on the same binding somewhere in the module
+  (a close/()/shutdown path).
+
+The bug class: pre-PR-6 ``PrefetchingIter`` started non-daemon workers
+with no join path — a worker exception left the process alive but
+wedged at exit, and worker errors were swallowed with it. A thread
+with neither declaration is a leak whose failure mode appears only at
+shutdown, the least-debuggable moment.
+
+``threading.Timer`` is exempt (one-shot, self-terminating).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import dotted, expr_token, kwarg
+from ..core import Checker, Finding
+
+_THREAD_CTOR = re.compile(r"(^|\.)Thread$")
+
+
+class ThreadChecker(Checker):
+    name = "thread-lifecycle"
+    description = ("every started Thread has daemon=True or a .join() "
+                   "path on its binding")
+
+    def check_module(self, mod):
+        findings = []
+        # Module-wide fact tables, collected once.
+        joined, daemoned = set(), set()
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                tok = expr_token(node.func.value)
+                if tok:
+                    joined.add(tok)
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and node.targets[0].attr == "daemon"
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True):
+                tok = expr_token(node.targets[0].value)
+                if tok:
+                    daemoned.add(tok)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and _THREAD_CTOR.search(dotted(node.func) or "")):
+                continue
+            d = kwarg(node, "daemon")
+            if isinstance(d, ast.Constant) and d.value is True:
+                continue
+            tok = self._binding(mod.tree, node)
+            if tok and (tok in joined or tok in daemoned
+                        # 'self._t' joined as bare '_t' alias and vice
+                        # versa: match on the attribute tail too.
+                        or tok.split(".")[-1]
+                        in {j.split(".")[-1] for j in joined | daemoned}):
+                continue
+            findings.append(Finding(
+                mod.relpath, node.lineno, self.name,
+                "Thread started without daemon=True or a reachable "
+                ".join() on its binding — leaks at shutdown and "
+                "swallows worker errors (the pre-PR-6 PrefetchingIter "
+                "bug); add a close()/join path or declare it daemon"))
+        return findings
+
+    @staticmethod
+    def _binding(tree, ctor):
+        """Token the Thread ctor's result is bound to, if any."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and node.value is ctor:
+                return expr_token(node.targets[0])
+        return None

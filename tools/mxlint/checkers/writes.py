@@ -1,0 +1,87 @@
+"""Checker: atomic-write discipline.
+
+Every durable artifact this framework produces commits through a
+staging-file + fsync + atomic-rename seam; a plain ``open(path, "w")``
+dies at any byte as a *torn file* the reader then trusts (the bug class
+fixed four separate times: checkpoint manifests in PR 2, trace segments
+in PR 5, flight-recorder bundles in PR 7, compile-cache entries in
+PR 9). This rule pins it: any write-mode ``open()`` outside the
+sanctioned commit seams is an error.
+
+Sanctioned seams (the implementations themselves):
+
+- ``mxnet_tpu/base.py::atomic_write``           (single-file protocol)
+- ``mxnet_tpu/checkpoint/manager.py::_open_for_write``  (fault-injectable
+  checkpoint IO seam; its callers stage + ``_rename``)
+- ``mxnet_tpu/telemetry/export.py::commit_bytes``        (byte-blob commit)
+
+Writers that are *streams by design* (e.g. the RecordIO data-file
+writer, whose incremental append semantics are the API) carry a
+justified inline suppression instead.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted
+from ..core import Checker, Finding
+
+# (relpath suffix, enclosing function) pairs allowed to open for write.
+SANCTIONED = {
+    ("mxnet_tpu/base.py", "atomic_write"),
+    ("mxnet_tpu/checkpoint/manager.py", "_open_for_write"),
+    ("mxnet_tpu/telemetry/export.py", "commit_bytes"),
+}
+
+_WRITE_CHARS = set("wax+")
+
+
+def _is_write_mode(call):
+    """True when an ``open``-family call's literal mode writes."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default 'r'
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return bool(_WRITE_CHARS & set(mode.value))
+    return False      # non-literal mode: pass-through seams handle it
+
+
+class WriteChecker(Checker):
+    name = "atomic-write"
+    description = ("write-mode open() only inside the sanctioned "
+                   "atomic-commit seams")
+
+    def check_module(self, mod):
+        findings = []
+        stack = []
+
+        def visit(node):
+            is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if is_fn:
+                stack.append(node.name)
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in ("open", "io.open", "gzip.open", "bz2.open",
+                            "lzma.open") and _is_write_mode(node):
+                    fn = stack[-1] if stack else "<module>"
+                    if not any(mod.relpath.endswith(sfx) and fn == sanc
+                               for sfx, sanc in SANCTIONED):
+                        findings.append(Finding(
+                            mod.relpath, node.lineno, self.name,
+                            "write-mode open() outside the atomic-commit "
+                            "seams — a crash mid-write leaves a torn "
+                            "file; route through base.atomic_write / "
+                            "export.commit_bytes / the checkpoint "
+                            "_open_for_write+_rename seam"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_fn:
+                stack.pop()
+
+        visit(mod.tree)
+        return findings

@@ -1,0 +1,249 @@
+"""mxlint core: finding model, suppressions, project context, runner.
+
+The suite is AST-based (stdlib ``ast`` only — no third-party deps) and
+project-aware: every checker encodes an invariant this codebase already
+relies on (lock discipline, signal-handler safety, atomic writes, the
+env-knob catalogue, thread lifecycle, telemetry naming). See
+``tools/mxlint/checkers/`` for the rules and README "Static analysis"
+for the why behind each one.
+
+Suppression syntax (line-level, justification REQUIRED)::
+
+    risky_call()  # mxlint: disable=<check>[,<check>] -- <why this is safe>
+
+or, when the justification doesn't fit on the flagged line, a
+whole-line comment suppressing the NEXT line::
+
+    # mxlint: disable=<check> -- <why this is safe>
+    risky_call()
+
+A ``disable`` without the ``-- <justification>`` tail is itself a
+finding (``bad-suppression``) — the point is a searchable record of
+*why* each exception is sound, not a mute button.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Finding", "ModuleInfo", "ProjectContext", "Checker",
+    "run", "iter_py_files", "render_text", "render_json",
+]
+
+# ``# mxlint: disable=a,b -- justification`` (justification optional in
+# the grammar so we can *detect* its absence and flag it).
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*disable=([a-z0-9_,-]+)\s*(?:--\s*(\S.*))?$")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation, anchored to a source line."""
+    path: str       # repo-relative, '/'-separated (stable across hosts)
+    line: int
+    check: str
+    message: str
+
+    def as_dict(self):
+        return {"path": self.path, "line": self.line,
+                "check": self.check, "message": self.message}
+
+
+class ModuleInfo:
+    """One parsed source file handed to every checker."""
+
+    def __init__(self, abspath, relpath, source):
+        self.abspath = abspath
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        # line -> (set of disabled check names, has_justification);
+        # names are explicit only — no wildcard, each exception is
+        # scoped to the one rule it answers for
+        self.suppressions = {}
+        for i, text in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                checks = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                # A comment-only line suppresses the next CODE line (so
+                # a justification never forces an overlong code line and
+                # may continue over several comment lines).
+                line = i
+                if text.strip().startswith("#"):
+                    line = i + 1
+                    while (line <= len(self.lines)
+                           and self.lines[line - 1].strip().startswith("#")):
+                        line += 1
+                prev = self.suppressions.get(line)
+                if prev is not None:
+                    # Stacked suppression comments for one code line:
+                    # merge, demanding every stacked form be justified.
+                    checks = checks | prev[0]
+                    self.suppressions[line] = (checks,
+                                               bool(m.group(2)) and prev[1])
+                else:
+                    self.suppressions[line] = (checks, bool(m.group(2)))
+
+
+class ProjectContext:
+    """Repo-level facts shared by the checkers (knob catalogue, README)."""
+
+    def __init__(self, root):
+        # Absolute from the start: env.py self-identification compares
+        # against ModuleInfo.abspath, which is always absolute.
+        root = os.path.abspath(root) if root else root
+        self.root = root
+        self.catalogue = set()      # declared env knobs (name strings)
+        self.catalogue_lines = {}   # name -> line in env.py
+        self.env_py = None
+        self.readme_names = set()   # MXNET_*/DMLC_* tokens in README.md
+        env_py = os.path.join(root, "mxnet_tpu", "env.py") if root else None
+        if env_py and os.path.isfile(env_py):
+            self.env_py = os.path.normpath(env_py)
+            with open(env_py, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=env_py)
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "Knob" and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    self.catalogue.add(node.args[0].value)
+                    self.catalogue_lines[node.args[0].value] = node.lineno
+        readme = os.path.join(root, "README.md") if root else None
+        if readme and os.path.isfile(readme):
+            with open(readme, "r", encoding="utf-8") as f:
+                self.readme_names = set(
+                    re.findall(r"\b(?:MXNET|DMLC)_[A-Z0-9_]+\b", f.read()))
+
+
+class Checker:
+    """Base class: one invariant, three hooks."""
+
+    name = "abstract"
+    description = ""
+
+    def begin_project(self, ctx: ProjectContext):
+        pass
+
+    def check_module(self, mod: ModuleInfo):  # -> iterable[Finding]
+        return ()
+
+    def finalize(self):  # -> iterable[Finding] (cross-module rules)
+        return ()
+
+
+def find_project_root(start):
+    """Walk up from `start` to the directory holding mxnet_tpu/env.py."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        if os.path.isfile(os.path.join(d, "mxnet_tpu", "env.py")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+@dataclass
+class RunResult:
+    findings: list = field(default_factory=list)
+    suppressed: int = 0
+    files: int = 0
+    errors: list = field(default_factory=list)   # (path, message)
+
+
+def run(paths, checkers, root=None):
+    """Run `checkers` over every .py under `paths`; returns RunResult.
+
+    Suppressions are applied here (same line, matching check name); a
+    suppression missing its justification surfaces as a
+    ``bad-suppression`` finding that cannot itself be suppressed.
+    """
+    root = root or find_project_root(paths[0] if paths else ".") or os.getcwd()
+    root = os.path.abspath(root)
+    ctx = ProjectContext(root)
+    for c in checkers:
+        c.begin_project(ctx)
+    result = RunResult()
+    raw = []
+    mods = []
+    for abspath in iter_py_files(paths):
+        abspath = os.path.abspath(abspath)
+        rel = os.path.relpath(abspath, root)
+        try:
+            with open(abspath, "r", encoding="utf-8") as f:
+                mod = ModuleInfo(abspath, rel, f.read())
+        except (OSError, SyntaxError, ValueError) as exc:
+            result.errors.append((rel, str(exc)))
+            continue
+        result.files += 1
+        mods.append(mod)
+        for c in checkers:
+            raw.extend(c.check_module(mod))
+    for c in checkers:
+        raw.extend(c.finalize())
+    by_path = {m.relpath: m for m in mods}
+    for f in sorted(raw):
+        mod = by_path.get(f.path)
+        sup = mod.suppressions.get(f.line) if mod else None
+        if sup is not None:
+            checks, justified = sup
+            if f.check in checks:
+                if justified:
+                    result.suppressed += 1
+                    continue
+                result.findings.append(Finding(
+                    f.path, f.line, "bad-suppression",
+                    "suppression of '%s' has no justification — use "
+                    "'# mxlint: disable=%s -- <why this is safe>'"
+                    % (f.check, f.check)))
+                continue
+        result.findings.append(f)
+    return result
+
+
+def render_text(result):
+    out = []
+    for f in result.findings:
+        out.append("%s:%d: [%s] %s" % (f.path, f.line, f.check, f.message))
+    for path, msg in result.errors:
+        out.append("%s: [parse-error] %s" % (path, msg))
+    out.append("mxlint: %d file(s), %d finding(s), %d suppressed"
+               % (result.files, len(result.findings), result.suppressed))
+    return "\n".join(out)
+
+
+def render_json(result):
+    """Stable machine-readable output (for --compare-style diffing)."""
+    counts = {}
+    for f in result.findings:
+        counts[f.check] = counts.get(f.check, 0) + 1
+    return json.dumps({
+        "version": 1,
+        "files": result.files,
+        "suppressed": result.suppressed,
+        "counts": dict(sorted(counts.items())),
+        "findings": [f.as_dict() for f in result.findings],
+        "errors": [{"path": p, "message": m} for p, m in result.errors],
+    }, indent=2, sort_keys=True)
