@@ -201,6 +201,123 @@ def _serving_rows():
         srv.shutdown()
 
 
+def _serving_gateway_rows():
+    """Gateway section (mxnet_tpu.serving.gateway, ISSUE 15): 2-model
+    mixed load with a mid-run zero-drop hot swap and SLO-coupled
+    shedding. One model ("hot") is flooded past an unmeetable SLO so
+    its lowest deadline class sheds; the other ("steady") runs moderate
+    load and is hot-swapped mid-run. THE CONTRACT ROWS:
+
+    - gateway_swap_dropped_requests == 0 — no request is dropped by
+      the swap (sheds on the hot model's lowest class are the POLICY
+      working, counted separately);
+    - gateway_protected_p99_ms <= 250 — the non-overloaded model's p99
+      stays pinned while the other model burns and sheds.
+    """
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import ModelGateway, ModelSpec, \
+        ServiceUnavailableError, QueueFullError, hot_swap
+
+    rng = np.random.RandomState(0)
+
+    def mlp_params(scale):
+        return [mx.nd.array(rng.randn(784, 256).astype(np.float32)
+                            * scale),
+                mx.nd.zeros((256,)),
+                mx.nd.array(rng.randn(256, 10).astype(np.float32)
+                            * scale)]
+
+    def fwd(w1, b1, w2, x):
+        return mx.nd.dot(mx.nd.relu(mx.nd.dot(x, w1) + b1), w2)
+
+    gw = ModelGateway(max_queue=512, max_delay_ms=2.0,
+                      burn_windows=(0.5, 2.0), eval_interval_s=0.1,
+                      shed_burn_rate=5.0)
+    dropped = []        # hard failures (the contract quantity)
+    sheds = []          # policy sheds on the hot model's lowest class
+    results = {"hot": 0, "steady": 0}
+    stop = threading.Event()
+    lock = threading.Lock()
+    try:
+        gw.register(ModelSpec(
+            "hot", fn=fwd, params=mlp_params(0.05), item_shape=(784,),
+            max_batch=32, weight=1.0,
+            deadline_classes=(("interactive", None), ("best_effort",
+                                                      None)),
+            slo=(0.99, 0.0005)))     # unmeetable: every request burns
+        gw.register(ModelSpec(
+            "steady", fn=fwd, params=mlp_params(0.05), item_shape=(784,),
+            max_batch=32, weight=1.0))
+
+        def hammer(model, cls, n_rows):
+            x = rng.rand(n_rows, 784).astype(np.float32)
+            while not stop.is_set():
+                try:
+                    gw.predict(model, x, deadline_class=cls)
+                    with lock:
+                        results[model] += 1
+                except (ServiceUnavailableError, QueueFullError) as exc:
+                    if model == "hot":
+                        with lock:
+                            sheds.append(exc)
+                    else:
+                        with lock:
+                            dropped.append(exc)
+                except Exception as exc:
+                    with lock:
+                        dropped.append(exc)
+
+        threads = [threading.Thread(target=hammer,
+                                    args=("hot", "interactive", 4))
+                   for _ in range(2)]
+        threads += [threading.Thread(target=hammer,
+                                     args=("hot", "best_effort", 4))
+                    for _ in range(2)]
+        threads += [threading.Thread(target=hammer,
+                                     args=("steady", "default", 4))
+                    for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)              # let the burn monitor see the SLO
+        t0 = time.perf_counter()
+        gen = hot_swap(gw, "steady", params=mlp_params(0.07))
+        swap_ms = (time.perf_counter() - t0) * 1e3
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        stats = gw.stats()
+        shedding_seen = len(sheds) > 0 or \
+            stats["hot"]["shed"].get("slo_burn:best_effort", 0) > 0
+        # THE CONTRACT ROW: the swap (and the hot model's overload)
+        # dropped nothing — every steady request and every non-shed hot
+        # request completed.
+        _emit("gateway_swap_dropped_requests", len(dropped), "req")
+        _emit("gateway_swap_generation", gen, "gen")
+        _emit("gateway_swap_total_ms", round(swap_ms, 1), "ms")
+        # THE CONTRACT ROW: the healthy model's p99 while the other
+        # model burned and shed.
+        _emit("gateway_protected_p99_ms",
+              round(stats["steady"]["p99_ms"], 2), "ms")
+        _emit("gateway_hot_p99_ms", round(stats["hot"]["p99_ms"], 2),
+              "ms")
+        # registry counter only: the client-observed `sheds` list is
+        # the SAME events (submit increments the counter, then raises).
+        _emit("gateway_hot_sheds",
+              int(stats["hot"]["shed"].get("slo_burn:best_effort", 0)),
+              "req")
+        _emit("gateway_slo_shedding_engaged", int(shedding_seen), "bool")
+        _emit("gateway_steady_req_per_sec", round(results["steady"] / 3.0,
+                                                  1), "req/s")
+        _emit("gateway_hot_req_per_sec", round(results["hot"] / 3.0, 1),
+              "req/s")
+    finally:
+        stop.set()
+        gw.shutdown()
+
+
 def _telemetry_rows():
     """Telemetry section (mxnet_tpu.telemetry): instrumentation overhead
     on the step path. The SAME TrainStep loop is timed with telemetry
@@ -880,7 +997,9 @@ def compare(a_path, b_path):
     # Perf-contract deltas first: the step-hot-path rows two runs are
     # most often compared on (overlap efficiency, fused speedup).
     for metric, unit in (("fused_overlap_efficiency", "share"),
-                         ("trainer_fused_update_speedup", "x")):
+                         ("trainer_fused_update_speedup", "x"),
+                         ("gateway_swap_dropped_requests", "req"),
+                         ("gateway_protected_p99_ms", "ms")):
         if metric in a or metric in b:
             va = float(a.get(metric, {}).get("value", 0) or 0)
             vb = float(b.get(metric, {}).get("value", 0) or 0)
@@ -1427,6 +1546,11 @@ def main():
         _serving_rows()
     except Exception:
         print("bench serving section failed:", file=sys.stderr)
+        traceback.print_exc()
+    try:
+        _serving_gateway_rows()
+    except Exception:
+        print("bench serving_gateway section failed:", file=sys.stderr)
         traceback.print_exc()
     try:
         _telemetry_rows()

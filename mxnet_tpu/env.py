@@ -66,6 +66,19 @@ CATALOGUE = [
          "directory (NFS/GCS-fuse): skip the kvstore cc_* distribution "
          "channel — entries already commit atomically, so concurrent "
          "ranks are safe", False),
+    Knob("MXNET_GATEWAY_MAX_QUEUE", int, 256, "serving/gateway.py",
+         "inference gateway: TOTAL queued requests across all "
+         "registered models (one bounded admission pool); past it "
+         "submit() raises QueueFullError", False),
+    Knob("MXNET_GATEWAY_SHED_BURN_RATE", float, 14.4, "serving/gateway.py",
+         "inference gateway: SLO burn rate at which a model's "
+         "admission starts shedding its LOWEST deadline class "
+         "(503) instead of letting p99 collapse for everyone", False),
+    Knob("MXNET_GATEWAY_DRAIN_TIMEOUT_S", float, 30.0,
+         "serving/gateway.py",
+         "hot reload: how long swap_backend waits for in-flight "
+         "batches of the old generation to drain before returning "
+         "with the old executables still referenced", False),
     Knob("MXNET_PROFILER_AUTOSTART", int, 0, "profiler.py",
          "start device+dispatch profiling at import", False),
     Knob("MXNET_PROFILE_HZ", float, 67.0, "telemetry/profiling.py",

@@ -8,21 +8,39 @@ micro-batcher coalescing concurrent requests under a latency deadline;
 bounded-queue admission with deadline shedding; per-bucket stats in
 ``mx.profiler.dumps()``.
 
-Lifecycle::
+Single model::
 
     srv = serving.InferenceServer(fn, params, item_shape=(784,),
                                   max_batch=32, max_delay_ms=5)
     fut = srv.submit(x)          # x: (k, *item_shape), k <= max_batch
     y = fut.result()             # or srv.predict(x)
     srv.shutdown()               # or use `with serving.InferenceServer(...)`
+
+Many models — the gateway (one admission pool, fair-share scheduling,
+per-model SLO shedding, quantized/mesh-sharded variants, zero-drop hot
+reload)::
+
+    gw = serving.ModelGateway()
+    gw.register(serving.ModelSpec("mnist", fn=f, params=w,
+                                  item_shape=(784,), weight=2.0,
+                                  slo=(0.99, 0.250)))
+    res = gw.predict("mnist", x)         # GatewayResult: .output,
+    serving.hot_swap(gw, "mnist", params=w2)   # .generation, .model
+    gw.shutdown()
 """
 from .admission import AdmissionController, DeadlineExceededError, \
     QueueFullError, ServiceUnavailableError
 from .batcher import DynamicBatcher
 from .buckets import BucketPolicy
 from .engine import InferenceServer
+from .gateway import GatewayResult, ModelGateway
 from .metrics import ServingMetrics
+from .registry import MeshShardedModel, ModelRegistry, ModelSpec, \
+    QuantizedFnModel
+from .reload import hot_swap
 
 __all__ = ["InferenceServer", "BucketPolicy", "DynamicBatcher",
            "ServingMetrics", "AdmissionController", "QueueFullError",
-           "DeadlineExceededError", "ServiceUnavailableError"]
+           "DeadlineExceededError", "ServiceUnavailableError",
+           "ModelGateway", "GatewayResult", "ModelRegistry", "ModelSpec",
+           "QuantizedFnModel", "MeshShardedModel", "hot_swap"]

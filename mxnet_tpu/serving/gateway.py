@@ -1,0 +1,758 @@
+"""mxnet_tpu.serving.gateway — multi-model inference gateway.
+
+One process, N named models, ONE bounded admission pool and ONE worker
+thread owning all device calls. The single-model ``InferenceServer``
+scales out by replication; this gateway is the tier that fronts many
+models at once (ROADMAP direction 1):
+
+* **Fair-share scheduling.** Each model owns a micro-batcher queue
+  (the two-trigger policy: dispatch on a full bucket or when the
+  oldest request has waited ``max_delay_ms``). Among models with a
+  dispatchable batch, the worker picks by *smooth weighted
+  round-robin* over the specs' ``weight`` — a hot model gets its
+  proportional share and can never starve the rest (its excess load
+  queues against the shared pool bound and sheds at ITS door).
+
+* **Deadline classes.** A request names a class from its model's
+  ordered ladder (``ModelSpec.deadline_classes``) and inherits the
+  class deadline. Expired queued requests shed exactly like the
+  single-model server.
+
+* **SLO-coupled shedding.** A model with a declared ``slo=`` gets a
+  :class:`~..telemetry.slo.ServiceLevelObjective` over its own
+  ``mx_serving_gateway_request_latency_seconds{model=...}`` series,
+  evaluated by one :class:`~..telemetry.slo.BurnRateMonitor`. While
+  every window burns past ``shed_burn_rate``, admission sheds that
+  model's LOWEST deadline class (503) — load shedding by priority
+  instead of collapsing p99 for every caller of every model.
+
+* **Per-model readiness.** Every model claims its own health-plane
+  component slot (``gateway/<name>``): a model still warming (or
+  registered with ``warmup=False``) sheds 503 for ITSELF only while
+  the other models keep serving; ``unregister`` releases the slot.
+
+* **Hot reload.** :func:`.reload.hot_swap` builds + warms a NEW
+  backend off-path, then :meth:`ModelGateway.swap_backend` swaps the
+  executable cache atomically under the registry's generation counter.
+  Every response is a :class:`GatewayResult` tagged with the
+  generation that produced it, so no request can mix versions.
+
+Telemetry: ``mx_serving_gateway_*{model=...}`` families,
+``serving::gateway_*``/``serving::swap`` spans, one ``serving`` lane on
+the hang watchdog, one readiness slot per model.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import log as _log
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from ..telemetry import healthplane as _hp
+from ..telemetry import metrics as _tm
+from ..telemetry import trace as _trace
+from ..telemetry import watchdog as _watchdog
+from ..telemetry.slo import BurnRateMonitor, ServiceLevelObjective
+from .admission import QueueFullError, ServiceUnavailableError, \
+    DeadlineExceededError
+from .registry import ModelRegistry, ModelSpec
+
+__all__ = ["ModelGateway", "GatewayResult"]
+
+_gw_requests = _tm.REGISTRY.counter(
+    "mx_serving_gateway_requests_total",
+    "Requests admitted to the gateway pool",
+    labels=("model", "deadline_class"))
+_gw_batches = _tm.REGISTRY.counter(
+    "mx_serving_gateway_batches_total",
+    "Gateway device batch calls", labels=("model", "bucket"))
+_gw_rows = _tm.REGISTRY.counter(
+    "mx_serving_gateway_rows_total",
+    "Real (unpadded) rows executed per model and bucket",
+    labels=("model", "bucket"))
+_gw_latency = _tm.REGISTRY.histogram(
+    "mx_serving_gateway_request_latency_seconds",
+    "submit()-to-result latency per request (queueing included); the "
+    "family each model's SLO burn rate evaluates", labels=("model",))
+_gw_shed = _tm.REGISTRY.counter(
+    "mx_serving_gateway_shed_total",
+    "Requests shed at the gateway: reason=queue_full|deadline|unready|"
+    "slo_burn", labels=("model", "reason", "deadline_class"))
+_gw_queue = _tm.REGISTRY.gauge(
+    "mx_serving_gateway_queue_depth",
+    "Queued requests per model", labels=("model",))
+_gw_generation = _tm.REGISTRY.gauge(
+    "mx_serving_gateway_generation",
+    "Committed model version (bumped by every hot reload)",
+    labels=("model",))
+_gw_shedding = _tm.REGISTRY.gauge(
+    "mx_serving_gateway_slo_shedding",
+    "1 while a model's SLO burn rate sheds its lowest deadline class",
+    labels=("model",))
+
+_logger = _log.get_logger("mxnet_tpu.serving")
+
+
+class GatewayResult:
+    """One request's outcome: the output rows plus the model version
+    that produced them — every response carries exactly ONE generation,
+    which is how the no-mixed-weights reload contract is asserted."""
+
+    __slots__ = ("output", "model", "generation")
+
+    def __init__(self, output, model, generation):
+        self.output = output
+        self.model = model
+        self.generation = generation
+
+    def __repr__(self):
+        return "GatewayResult(model=%r, generation=%d, output=%r)" % (
+            self.model, self.generation, self.output)
+
+
+class _GwRequest:
+    __slots__ = ("data", "rows", "future", "deadline", "t_submit", "cls")
+
+    def __init__(self, data, rows, deadline, t_submit, cls):
+        self.data = data
+        self.rows = rows
+        self.future = Future()
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.cls = cls
+
+
+class _ModelState:
+    __slots__ = ("spec", "backend", "generation", "component", "queue",
+                 "rows_queued", "current", "ready", "shedding", "slo",
+                 "warmed", "inflight")
+
+    def __init__(self, spec, backend, generation, component):
+        self.spec = spec
+        self.backend = backend
+        self.generation = generation
+        self.component = component
+        self.queue = deque()
+        self.rows_queued = 0
+        self.current = 0.0        # smooth-WRR accumulator
+        self.ready = False
+        self.shedding = False
+        self.slo = None
+        self.warmed = set()
+        self.inflight = {}        # generation -> in-flight batch count
+
+
+class ModelGateway:
+    """N models behind one bounded admission pool.
+
+    Parameters
+    ----------
+    registry : ModelRegistry, optional (one is created when omitted).
+    max_queue : int
+        TOTAL queued requests across all models
+        (default ``MXNET_GATEWAY_MAX_QUEUE``); past it ``submit()``
+        raises :class:`QueueFullError`.
+    max_delay_ms : float
+        Per-model batching window (two-trigger micro-batching).
+    shed_burn_rate : float
+        Burn rate at which a model's SLO starts shedding its lowest
+        deadline class (default ``MXNET_GATEWAY_SHED_BURN_RATE``).
+    burn_windows : SLO evaluation windows in seconds (short, serving-
+        scale defaults — shedding must react in seconds, not the
+        alerting-scale 5m/1h).
+    eval_interval_s : at most one burn evaluation per this many seconds.
+    clock : injectable monotonic clock for the burn-rate machinery.
+    monitor : optional telemetry.StepMonitor for burn-alert routing.
+    ctx : device context for request batches (default device if None).
+    start : start the worker thread at construction (default True).
+    """
+
+    def __init__(self, registry=None, *, max_queue=None, max_delay_ms=5.0,
+                 shed_burn_rate=None, burn_windows=(60.0, 300.0),
+                 eval_interval_s=5.0, clock=time.monotonic, monitor=None,
+                 ctx=None, start=True):
+        from .. import env as _env
+
+        self.registry = registry if registry is not None else ModelRegistry()
+        self._max_queue = int(max_queue if max_queue is not None
+                              else _env.get("MXNET_GATEWAY_MAX_QUEUE"))
+        if self._max_queue < 1:
+            raise ValueError("max_queue must be >= 1, got %r"
+                             % (self._max_queue,))
+        self._max_delay = float(max_delay_ms) / 1e3
+        self._shed_burn = float(
+            shed_burn_rate if shed_burn_rate is not None
+            else _env.get("MXNET_GATEWAY_SHED_BURN_RATE"))
+        self._burn = BurnRateMonitor(
+            windows=burn_windows, alert_burn_rate=self._shed_burn,
+            eval_interval_s=eval_interval_s, monitor=monitor, clock=clock)
+        self._burn_lock = threading.Lock()
+        self._ctx = ctx
+        self._models = {}
+        self._cond = threading.Condition()
+        self._total = 0
+        self._running = False
+        self._paused = False
+        self._closed = False
+        self._thread = None
+        self._wd_lane = _watchdog.unique_lane("serving")
+        if start:
+            self.start()
+
+    # -- model lifecycle -------------------------------------------------------
+
+    def register(self, spec=None, warmup=True, **kwargs):
+        """Register a model (a :class:`ModelSpec`, or its kwargs) and
+        build its version-1 backend. With ``warmup=True`` the full
+        bucket ladder compiles before returning (cache-warm under the
+        persistent compile cache) and the model turns ready; with
+        ``warmup=False`` the model sheds 503 until
+        :meth:`warmup` is called — other models are unaffected
+        (readiness is per model). Returns the spec."""
+        if spec is None:
+            spec = ModelSpec(**kwargs)
+        elif kwargs:
+            raise ValueError("pass a ModelSpec OR its kwargs, not both")
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("gateway is shut down")
+        self.registry.register(spec)
+        try:
+            backend = spec.build_backend()
+        except Exception:
+            self.registry.unregister(spec.name)
+            raise
+        component = _hp.unique_component("gateway/%s" % spec.name)
+        st = _ModelState(spec, backend, self.registry.generation(spec.name),
+                         component)
+        if spec.slo is not None:
+            objective, threshold_s = spec.slo
+            st.slo = ServiceLevelObjective(
+                "gateway_%s" % spec.name, objective, threshold_s,
+                _gw_latency, labels={"model": spec.name})
+            with self._burn_lock:
+                self._burn.add(st.slo)
+        with self._cond:
+            if self._closed:
+                # shutdown raced the build: unwind every side effect so
+                # no ghost registry entry / not-ready component / SLO
+                # survives the refused registration.
+                closed = True
+            else:
+                closed = False
+                self._models[spec.name] = st
+        if closed:
+            self.registry.unregister(spec.name)
+            _hp.clear_ready(component)
+            if st.slo is not None:
+                with self._burn_lock:
+                    self._burn.remove(st.slo.name)
+            raise RuntimeError("gateway is shut down")
+        _gw_generation.labels(model=spec.name).set(st.generation)
+        _gw_queue.labels(model=spec.name).set(0)
+        if warmup:
+            self.warmup(spec.name)
+        return spec
+
+    def warm_backend(self, spec, backend, skip=()):
+        """Compile a backend's bucket ladder (minus ``skip``) with the
+        same device placement the serving path uses — THE warmup for
+        registration and for reload's off-path new-version warmup.
+        Returns the set of warmed buckets."""
+        warmed = set()
+        for b in spec.policy.buckets:
+            if b in skip:
+                continue
+            batch = nd.array(np.zeros((b,) + spec.item_shape, spec.dtype),
+                             ctx=spec.ctx if spec.ctx is not None
+                             else self._ctx)
+            out = backend(batch)
+            for o in (out if isinstance(out, tuple) else (out,)):
+                o.wait_to_read()
+            warmed.add(b)
+        return warmed
+
+    def warmup(self, name):
+        """Compile the model's full bucket ladder (idempotent) and flip
+        its readiness slot. Safe while the gateway serves other models:
+        the backend is private to this model and unreachable by the
+        worker until readiness flips."""
+        st = self._state(name)
+        st.warmed |= self.warm_backend(st.spec, st.backend,
+                                       skip=st.warmed)
+        if not st.ready:
+            st.ready = True
+            _hp.set_ready(st.component)
+        with self._cond:
+            self._cond.notify_all()
+        return self
+
+    def unregister(self, name):
+        """Drop a model: queued requests fail, its readiness slot is
+        RELEASED (no permanently not-ready ghost in ``/readyz``), its
+        SLO leaves the burn monitor, and its labeled series leave the
+        registry families."""
+        with self._cond:
+            st = self._models.pop(name, None)
+            if st is not None:
+                self._total -= len(st.queue)
+                failed = list(st.queue)
+                st.queue.clear()
+                st.rows_queued = 0
+        if st is None:
+            raise KeyError("model %r is not registered" % (name,))
+        self.registry.unregister(name)
+        for req in failed:
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(
+                    ServiceUnavailableError("model %r unregistered" % name))
+        _hp.clear_ready(st.component)
+        if st.slo is not None:
+            with self._burn_lock:
+                self._burn.remove(st.slo.name)
+        self._drop_metrics(name)
+        return st.spec
+
+    @staticmethod
+    def _drop_metrics(name):
+        for fam in (_gw_requests, _gw_batches, _gw_rows, _gw_latency,
+                    _gw_shed, _gw_queue, _gw_generation, _gw_shedding):
+            for values, _ in fam.collect():
+                if values[0] == name:
+                    fam.remove(**dict(zip(fam.labelnames, values)))
+
+    def _state(self, name):
+        with self._cond:
+            st = self._models.get(name)
+        if st is None:
+            raise KeyError("model %r is not registered (have: %s)"
+                           % (name, self.models() or "none"))
+        return st
+
+    def models(self):
+        with self._cond:
+            return sorted(self._models)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self):
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("gateway is shut down")
+            if self._thread is not None:
+                return self
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._loop, name="mx-serving-gateway", daemon=True)
+            self._thread.start()
+        return self
+
+    def pause(self):
+        """Stop dispatching (submits still queue) — drain control and
+        deterministic-coalescing tests."""
+        with self._cond:
+            self._paused = True
+        return self
+
+    def resume(self):
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop the worker (drain semantics of the single-model
+        server), release the watchdog lane and every model's readiness
+        slot."""
+        with self._cond:
+            self._closed = True
+            self._running = False
+            self._paused = False
+            if not drain or self._thread is None:
+                for st in self._models.values():
+                    while st.queue:
+                        req = st.queue.popleft()
+                        if req.future.set_running_or_notify_cancel():
+                            req.future.set_exception(
+                                RuntimeError("gateway shut down"))
+                    st.rows_queued = 0
+                self._total = 0
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout if timeout is not None else 30)
+        _watchdog.reset(self._wd_lane)
+        with self._cond:
+            states = list(self._models.values())
+        for st in states:
+            _hp.clear_ready(st.component)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # -- request path ----------------------------------------------------------
+
+    def submit(self, model, data, deadline_class=None, timeout_ms=None):
+        """Enqueue one request for ``model``; returns a Future yielding
+        a :class:`GatewayResult`. ``deadline_class`` defaults to the
+        model's FIRST (highest-priority) class; ``timeout_ms``
+        overrides the class deadline."""
+        self._burn_tick()
+        st = self._state(model)
+        spec = st.spec
+        arr = data.asnumpy() if isinstance(data, NDArray) \
+            else np.array(data, dtype=spec.dtype)
+        if tuple(arr.shape[1:]) != spec.item_shape:
+            raise ValueError(
+                "request shape %r does not match (k,) + item_shape %r "
+                "of model %r" % (tuple(arr.shape), spec.item_shape, model))
+        rows = int(arr.shape[0])
+        if not 1 <= rows <= spec.policy.max_batch:
+            raise ValueError("request rows must be in [1, %d], got %d"
+                             % (spec.policy.max_batch, rows))
+        cls = deadline_class if deadline_class is not None \
+            else spec.default_class
+        if cls not in spec.class_timeouts:
+            raise ValueError("unknown deadline class %r for model %r "
+                             "(have: %s)" % (cls, model,
+                                             [c for c, _ in spec.classes]))
+        now = time.perf_counter()
+        if timeout_ms is None:
+            timeout_ms = spec.class_timeouts[cls]
+        deadline = now + timeout_ms / 1e3 if timeout_ms is not None else None
+        req = _GwRequest(arr.astype(spec.dtype, copy=False), rows,
+                         deadline, now, cls)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("gateway is shut down")
+            st2 = self._models.get(model)
+            if st2 is not st:
+                raise KeyError("model %r is not registered" % (model,))
+            if not st.ready:
+                _gw_shed.labels(model=model, reason="unready",
+                                deadline_class=cls).inc()
+                raise ServiceUnavailableError(
+                    "model %r is not ready (warmup in flight) — other "
+                    "models keep serving; retry another replica" % model)
+            if st.shedding and cls == spec.lowest_class:
+                _gw_shed.labels(model=model, reason="slo_burn",
+                                deadline_class=cls).inc()
+                raise ServiceUnavailableError(
+                    "model %r is burning its SLO error budget: shedding "
+                    "deadline class %r" % (model, cls))
+            if self._total >= self._max_queue:
+                _gw_shed.labels(model=model, reason="queue_full",
+                                deadline_class=cls).inc()
+                raise QueueFullError(
+                    "gateway pool full (%d pending, max_queue=%d)"
+                    % (self._total, self._max_queue))
+            st.queue.append(req)
+            st.rows_queued += rows
+            self._total += 1
+            depth = len(st.queue)
+            self._cond.notify_all()
+        _gw_requests.labels(model=model, deadline_class=cls).inc()
+        _gw_queue.labels(model=model).set(depth)
+        _trace.instant("serving::gateway_enqueue", model=model, rows=rows,
+                       depth=depth)
+        return req.future
+
+    def predict(self, model, data, deadline_class=None, timeout_ms=None):
+        """Synchronous submit; returns the :class:`GatewayResult`."""
+        return self.submit(model, data, deadline_class=deadline_class,
+                           timeout_ms=timeout_ms).result()
+
+    # -- hot reload seam (driven by serving.reload) ----------------------------
+
+    def swap_backend(self, name, backend, warmed=None, drain_timeout=None):
+        """Atomically commit a new backend under the registry's
+        generation counter, then wait for in-flight batches of the OLD
+        generation to drain. Admission never closes and queues are
+        untouched — zero dropped requests by construction. Returns
+        ``(new_generation, drained)``; after a drained return the old
+        backend (and its whole executable cache) is unreferenced."""
+        from .. import env as _env
+
+        if drain_timeout is None:
+            drain_timeout = _env.get("MXNET_GATEWAY_DRAIN_TIMEOUT_S")
+        with self._cond:
+            st = self._models.get(name)
+            if st is None:
+                raise KeyError("model %r is not registered" % (name,))
+            old_gen = st.generation
+            st.backend = backend
+            st.warmed = set(warmed if warmed is not None
+                            else st.spec.policy.buckets)
+            st.generation = self.registry.bump(name)
+            new_gen = st.generation
+            _trace.instant("serving::swap_commit", model=name,
+                           generation=new_gen)
+            deadline = time.monotonic() + float(drain_timeout)
+            while st.inflight.get(old_gen, 0) > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(0.1, remaining))
+            drained = st.inflight.get(old_gen, 0) == 0
+        _gw_generation.labels(model=name).set(new_gen)
+        return new_gen, drained
+
+    # -- stats -----------------------------------------------------------------
+
+    def stats(self):
+        """Per-model registry view: bucket occupancy, shed counts,
+        generation/readiness/shedding state, queue depth, p50/p99."""
+        out = {}
+        with self._cond:
+            states = dict(self._models)
+        for name, st in sorted(states.items()):
+            batches, rows, requests, shed = {}, {}, {}, {}
+            for values, child in _gw_batches.collect():
+                if values[0] == name:
+                    batches[values[1]] = child.value
+            for values, child in _gw_rows.collect():
+                if values[0] == name:
+                    rows[values[1]] = child.value
+            for values, child in _gw_requests.collect():
+                if values[0] == name and child.value:
+                    requests[values[1]] = child.value
+            for values, child in _gw_shed.collect():
+                if values[0] == name and child.value:
+                    shed["%s:%s" % (values[1], values[2])] = child.value
+            lat = None
+            for values, child in _gw_latency.collect():
+                if values[0] == name:
+                    lat = child
+            buckets = {}
+            for b in sorted(batches, key=int):
+                n_batches = batches[b]
+                buckets[int(b)] = {
+                    "batches": n_batches,
+                    "rows": rows.get(b, 0),
+                    "mean_occupancy": (rows.get(b, 0)
+                                       / (n_batches * int(b))
+                                       if n_batches else 0.0),
+                }
+            out[name] = {
+                "generation": st.generation,
+                "ready": st.ready,
+                "shedding": st.shedding,
+                "queue_depth": len(st.queue),
+                "requests": requests,
+                "buckets": buckets,
+                "shed": shed,
+                "p50_ms": (lat.quantile(0.50) if lat else 0.0) * 1e3,
+                "p99_ms": (lat.quantile(0.99) if lat else 0.0) * 1e3,
+            }
+        return out
+
+    # -- SLO-coupled shedding --------------------------------------------------
+
+    def _burn_tick(self):
+        """Evaluate SLO burn rates (rate-limited by eval_interval_s)
+        and flip per-model shedding state. Called from submit() and the
+        worker loop; serialized by its own lock, which is never held
+        while taking the queue lock's critical work."""
+        with self._burn_lock:
+            res = self._burn.tick()
+        if res is None:
+            return
+        with self._cond:
+            states = dict(self._models)
+        for name, st in states.items():
+            if st.slo is None:
+                continue
+            burns = res.get(st.slo.name)
+            if burns is None:
+                continue
+            shed = bool(burns) and min(burns.values()) >= self._shed_burn
+            if shed != st.shedding:
+                st.shedding = shed
+                _gw_shedding.labels(model=name).set(int(shed))
+                _trace.instant("serving::gateway_slo_shed", model=name,
+                               active=int(shed))
+
+    # -- worker ----------------------------------------------------------------
+
+    def _loop(self):
+        while True:
+            try:
+                self._burn_tick()
+            except Exception as exc:
+                # The burn monitor may route alerts through injected
+                # hooks (monitor=); a hook exception must never kill
+                # the one thread that owns every model's dispatch.
+                _log.warn_rate_limited(
+                    _logger, "gw_burn_tick", 60.0,
+                    "gateway burn-rate tick failed (SLO shedding state "
+                    "may be stale): %s", exc)
+            with self._cond:
+                while self._running and (self._paused
+                                         or self._total == 0):
+                    self._cond.wait(0.1)
+                if self._total == 0:
+                    if not self._running:
+                        return
+                    continue
+                self._shed_expired_locked()
+                if self._total == 0:
+                    continue
+                picked = self._pick_locked()
+                if picked is None:
+                    continue
+                st, batch = picked
+                backend, gen = st.backend, st.generation
+                st.inflight[gen] = st.inflight.get(gen, 0) + 1
+            try:
+                live = [r for r in batch
+                        if r.future.set_running_or_notify_cancel()]
+                if live:
+                    bucket = st.spec.policy.bucket_for(
+                        sum(r.rows for r in live))
+                    try:
+                        self._run_batch(st.spec, backend, gen, live,
+                                        bucket)
+                    except Exception as exc:
+                        for req in live:
+                            if not req.future.done():
+                                req.future.set_exception(exc)
+            finally:
+                with self._cond:
+                    n = st.inflight.get(gen, 0) - 1
+                    if n > 0:
+                        st.inflight[gen] = n
+                    else:
+                        st.inflight.pop(gen, None)
+                    self._cond.notify_all()
+                # Drop the backend reference before the next wait: a
+                # swapped-out generation must be released by the worker
+                # too, or its executables survive the drain.
+                st = backend = batch = live = None
+
+    _SHED_GRACE = 10e-3
+    _DEADLINE_MARGIN = 2e-3
+
+    def _shed_expired_locked(self):
+        now = time.perf_counter()
+        for name, st in self._models.items():
+            if not st.queue:
+                continue
+            live = deque()
+            while st.queue:
+                req = st.queue.popleft()
+                self._total -= 1
+                st.rows_queued -= req.rows
+                if req.future.cancelled():
+                    continue
+                if (req.deadline is not None
+                        and now > req.deadline + self._SHED_GRACE):
+                    if req.future.set_running_or_notify_cancel():
+                        req.future.set_exception(DeadlineExceededError(
+                            "request expired after %.1f ms in queue"
+                            % ((now - req.t_submit) * 1e3)))
+                    _gw_shed.labels(model=name, reason="deadline",
+                                    deadline_class=req.cls).inc()
+                else:
+                    live.append(req)
+                    self._total += 1
+                    st.rows_queued += req.rows
+            st.queue = live
+
+    def _due_at(self, st, now):
+        """When this model's queue becomes dispatchable: immediately on
+        a full bucket, else at the two-trigger delay (capped by the
+        earliest queued deadline so an idle device never sheds what it
+        had time to serve)."""
+        if st.rows_queued >= st.spec.policy.max_batch:
+            return now
+        due = st.queue[0].t_submit + self._max_delay
+        rows = 0
+        for req in st.queue:
+            if rows + req.rows > st.spec.policy.max_batch:
+                break
+            rows += req.rows
+            if req.deadline is not None:
+                due = min(due, req.deadline - self._DEADLINE_MARGIN)
+        return due
+
+    def _pick_locked(self):
+        """Smooth weighted round-robin over models with a dispatchable
+        batch; collects the picked model's FIFO prefix. Returns None
+        after waiting when nobody is due yet."""
+        now = time.perf_counter()
+        waiting = [st for st in self._models.values() if st.queue]
+        due = [st for st in waiting if self._due_at(st, now) <= now]
+        if not due:
+            if waiting:
+                wake = min(self._due_at(st, now) for st in waiting)
+                wait = wake - now
+                if wait > 0:
+                    self._cond.wait(wait)
+            return None
+        total_w = sum(st.spec.weight for st in due)
+        for st in due:
+            st.current += st.spec.weight
+        st = max(due, key=lambda s: s.current)
+        st.current -= total_w
+        take, rows = [], 0
+        while st.queue:
+            req = st.queue[0]
+            if rows + req.rows > st.spec.policy.max_batch:
+                break
+            take.append(st.queue.popleft())
+            rows += req.rows
+        st.rows_queued -= rows
+        self._total -= len(take)
+        _gw_queue.labels(model=st.spec.name).set(len(st.queue))
+        return st, take
+
+    def _run_batch(self, spec, backend, generation, requests, bucket):
+        """One device call for one model's coalesced batch — runs on
+        the worker thread under the serving watchdog lane."""
+        _watchdog.begin(self._wd_lane)
+        try:
+            t0 = time.perf_counter()
+            name = spec.name
+            batch = np.zeros((bucket,) + spec.item_shape, spec.dtype)
+            spans, off = [], 0
+            for req in requests:
+                batch[off:off + req.rows] = req.data
+                spans.append((req, off, off + req.rows))
+                off += req.rows
+            for req in requests:
+                _trace.complete("serving::gateway_queue_wait",
+                                req.t_submit, t0, model=name,
+                                rows=req.rows, bucket=bucket)
+            with _trace.span("serving::gateway_device", model=name,
+                             bucket=bucket, rows=off,
+                             generation=generation):
+                out = backend(nd.array(batch,
+                                       ctx=spec.ctx if spec.ctx is not None
+                                       else self._ctx))
+                outs = out if isinstance(out, tuple) else (out,)
+                for o in outs:
+                    o.wait_to_read()
+            b = str(bucket)
+            _gw_batches.labels(model=name, bucket=b).inc()
+            _gw_rows.labels(model=name, bucket=b).inc(off)
+            done = time.perf_counter()
+            lat = _gw_latency.labels(model=name)
+            for req, i0, i1 in spans:
+                sliced = tuple(o[i0:i1] for o in outs)
+                lat.observe(done - req.t_submit)
+                _trace.complete("serving::gateway_request", req.t_submit,
+                                done, model=name, rows=req.rows,
+                                bucket=bucket)
+                req.future.set_result(GatewayResult(
+                    sliced if len(sliced) > 1 else sliced[0],
+                    name, generation))
+        finally:
+            _watchdog.end(self._wd_lane)

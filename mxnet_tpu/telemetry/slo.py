@@ -200,7 +200,26 @@ class BurnRateMonitor:
         # at this cadence (+2 slack for edge samples).
         depth = int(self.windows[-1] / max(self.eval_interval_s, 1e-9)) + 2
         self._history[slo.name] = deque(maxlen=max(depth, 4))
+        # Seed the baseline NOW: an SLO registered mid-run (a gateway
+        # model added to a live monitor) must difference its first
+        # evaluation against registration time, not wait a full
+        # evaluation cycle to start burning.
+        bad, total = slo.totals()
+        self._history[slo.name].append((self._clock(), bad, total))
         return slo
+
+    def remove(self, name):
+        """Unregister an SLO: drop its history AND its emitted
+        ``mx_slo_burn_rate``/``mx_slo_alerts_total`` children (the
+        serving gateway's model-unregister path — a process cycling
+        models must not accumulate dead SLO series in every scrape).
+        Unknown names are a no-op."""
+        self._slos = [s for s in self._slos if s.name != name]
+        self._history.pop(name, None)
+        for fam in (self._burn_gauge, self._alerts):
+            for values, _ in fam.collect():
+                if values[0] == name:   # labelnames lead with "slo"
+                    fam.remove(**dict(zip(fam.labelnames, values)))
 
     def add_latency_slo(self, name, objective, threshold_s, family,
                         labels=None, registry=None):

@@ -1,0 +1,187 @@
+"""Worker program for the 2-process gateway acceptance (ISSUE 15).
+
+Launched by tools/launch.py with ``-s 0``: N processes x 1 local CPU
+device join one SPMD group, and each rank runs a ModelGateway with TWO
+registered models:
+
+* ``mesh`` — mesh-sharded over a {"tp": N} mesh SPANNING the
+  processes (each rank holds ONE shard of the weight: the
+  model-too-large-for-one-chip shape). Every rank drives the same
+  deterministic request schedule in lockstep — each device call is an
+  SPMD collective, the TrainStep discipline.
+* ``quant`` — int8 weight-only quantized, registered on rank 0 only
+  (purely local executables), hammered by concurrent threads for the
+  whole run; mid-run its weights hot-swap from a training-style
+  CheckpointManager commit.
+
+Checks (verified AFTER the lockstep schedule completes, so a failed
+check can never strand the peer inside an unmatched collective): mesh
+results match the unsharded numpy reference on EVERY rank; the weight
+is genuinely sharded across processes (one addressable shard each);
+the swap drops ZERO requests; responses span both generations, each
+tagged with exactly one; and post-swap responses bit-match a fresh
+load of the new checkpoint.
+
+Usage: gateway_mesh_prog.py OUT.json
+"""
+import json
+import os
+import sys
+import threading
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu.parallel import dist
+
+_, nproc, _ = dist.env_spec()
+nproc = nproc or 1
+dist.initialize(local_device_count=2 // nproc if nproc <= 2 else 1,
+                platform="cpu")
+
+import jax  # noqa: E402  (backend config above must come first)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import checkpoint, serving  # noqa: E402
+from mxnet_tpu.serving import ModelSpec, hot_swap  # noqa: E402
+
+MESH_REQUESTS = 20
+SWAP_AT = 8
+
+
+def _dot(w, x):
+    return mx.nd.dot(x, w)
+
+
+def main():
+    out_path = sys.argv[1]
+    rank = dist.rank()
+    rng = np.random.RandomState(7)
+    w_mesh = rng.randn(16, 8).astype(np.float32)
+    w_q1 = rng.randn(16, 8).astype(np.float32)
+    w_q2 = rng.randn(16, 8).astype(np.float32)
+
+    errors = []
+    report = {"rank": rank, "mesh_requests": 0}
+
+    assert len(jax.devices()) == 2, jax.devices()
+    gw = serving.ModelGateway(max_queue=4096, max_delay_ms=1.0)
+    gw.register(ModelSpec("mesh", fn=_dot, params=[mx.nd.array(w_mesh)],
+                          item_shape=(16,), max_batch=4,
+                          mesh_axes={"tp": 2}))
+    pv = gw._state("mesh").backend._param_vals[0]
+    report["addressable_shards"] = len(pv.addressable_shards)
+
+    quant_errors, quant_results = [], []
+    stop = threading.Event()
+    threads = []
+    mgr = None
+    swap_gen = [None]
+    if rank == 0:
+        gw.register(ModelSpec("quant", fn=_dot,
+                              params=[mx.nd.array(w_q1)],
+                              item_shape=(16,), max_batch=8,
+                              quantize="int8"))
+        # One synchronous pre-hammer request pins a generation-1
+        # response regardless of thread-start timing.
+        quant_results.append(gw.predict(
+            "quant", rng.rand(2, 16).astype(np.float32)))
+
+        def hammer():
+            xq = rng.rand(2, 16).astype(np.float32)
+            while not stop.is_set():
+                try:
+                    quant_results.append(gw.predict("quant", xq))
+                except Exception as exc:
+                    quant_errors.append(repr(exc))
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+
+    # -- deterministic lockstep schedule against the mesh model --------------
+    # (identical on every rank; each predict is one SPMD device call.
+    # NOTHING inside this loop may raise on one rank only — a dead rank
+    # strands the peer inside an unmatched collective.)
+    mesh_xs = [np.random.RandomState(100 + i).rand(3, 16)
+               .astype(np.float32) for i in range(MESH_REQUESTS)]
+    mesh_out = []
+    for i, x in enumerate(mesh_xs):
+        mesh_out.append(gw.predict("mesh", x))
+        report["mesh_requests"] += 1
+        if i == SWAP_AT and rank == 0:
+            # mid-run hot swap of the OTHER model, under fire, from a
+            # training-style checkpoint commit
+            try:
+                # Rank-0-local serving weights, NOT a sharded SPMD
+                # save: pin process_count=1 or the manager would wait
+                # for the other rank's shard.
+                mgr = checkpoint.CheckpointManager(
+                    os.path.join(os.path.dirname(out_path) or ".",
+                                 "gw_ckpt_r%d" % rank), keep_last=2,
+                    process_index=0, process_count=1)
+                mgr.save(1, {"w": w_q2}, sync=True)
+                swap_gen[0] = hot_swap(
+                    gw, "quant", manager=mgr,
+                    extract=lambda state: [mx.nd.array(state["w"])])
+            except Exception:
+                errors.append(traceback.format_exc())
+
+    if rank == 0:
+        stop.set()
+        for t in threads:
+            t.join(30)
+
+    # -- checks (the lockstep schedule is complete on every rank) ------------
+    try:
+        for x, res in zip(mesh_xs, mesh_out):
+            assert res.generation == 1
+            np.testing.assert_allclose(res.output.asnumpy(), x @ w_mesh,
+                                       rtol=1e-4, atol=1e-5)
+        if dist.num_processes() > 1:
+            # sharded ACROSS processes: one addressable shard per rank
+            assert len(pv.addressable_shards) == 1, pv.addressable_shards
+            assert pv.addressable_shards[0].data.shape == (8, 8)
+        if rank == 0:
+            assert not quant_errors, quant_errors[:3]
+            gens = {r.generation for r in quant_results}
+            assert gens == {1, 2}, gens
+            assert swap_gen[0] == 2, swap_gen
+            report["quant_requests"] = len(quant_results)
+            report["quant_dropped"] = len(quant_errors)
+            report["generations"] = sorted(gens)
+            # post-swap responses bit-match a FRESH load of the new
+            # checkpoint (same quantized build path, same executables)
+            _, state = mgr.restore()
+            fresh = gw.registry.spec("quant").build_backend(
+                params=[mx.nd.array(state["w"])])
+            xq = rng.rand(2, 16).astype(np.float32)
+            got = gw.predict("quant", xq)
+            assert got.generation == 2
+            pad = np.zeros((2, 16), np.float32)
+            want = fresh(mx.nd.array(np.vstack([xq, pad])))
+            np.testing.assert_array_equal(got.output.asnumpy(),
+                                          want.asnumpy()[:2])
+    except Exception:
+        errors.append(traceback.format_exc())
+    finally:
+        if mgr is not None:
+            mgr.close()
+        gw.shutdown()
+
+    # Every rank reaches the barrier whatever its checks found — error
+    # signaling is the exit code AFTER the collective plane is quiet.
+    dist.barrier("gateway_mesh_done")
+    if rank == 0:
+        report["errors"] = errors
+        with open(out_path, "w") as f:
+            json.dump(report, f)
+    if errors:
+        sys.stderr.write("\n".join(errors))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -288,6 +288,32 @@ def test_cached_op_warm_reload_compiles_nothing(tmp_path):
     assert np.allclose(out1.asnumpy(), out2.asnumpy())
 
 
+def test_executor_warm_reload_compiles_nothing(tmp_path):
+    """ISSUE 15 satellite: simple_bind Executors (the serving
+    checkpoint-model path) build their whole-graph forward through the
+    cached seam — a second Executor of the same symbol loads its
+    executable instead of compiling, so gateway warmup after a warm
+    restart compiles nothing."""
+    cc.configure(str(tmp_path))
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=5, name="ccx_fc")
+    args = {"ccx_fc_weight": nd.array(np.random.rand(5, 7)
+                                      .astype(np.float32)),
+            "ccx_fc_bias": nd.zeros((5,)),
+            "data": nd.array(np.random.rand(3, 7).astype(np.float32))}
+
+    ex1 = net.bind(mx.cpu(), args)
+    out1 = ex1.forward(is_train=False)[0]
+    fn1 = ex1._fwd_cache[False]
+    assert fn1.num_compiles == 1 and fn1.num_hits == 0
+
+    ex2 = net.bind(mx.cpu(), args)
+    out2 = ex2.forward(is_train=False)[0]
+    fn2 = ex2._fwd_cache[False]
+    assert fn2.num_compiles == 0 and fn2.num_hits == 1
+    np.testing.assert_array_equal(out1.asnumpy(), out2.asnumpy())
+
+
 def test_fused_apply_warm_reload_compiles_nothing(tmp_path):
     cc.configure(str(tmp_path))
 

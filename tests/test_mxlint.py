@@ -347,6 +347,83 @@ def knob_project(tmp_path):
     return tmp_path
 
 
+@pytest.fixture()
+def stale_project(tmp_path):
+    """Mini project for the stale-knob rule: env.py declares a read
+    knob, a dead knob, and a subsumed knob; x.py reads only the first."""
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "env.py").write_text(textwrap.dedent("""
+        from collections import namedtuple
+        Knob = namedtuple("Knob", "name typ default where doc subsumed")
+        CATALOGUE = [
+            Knob("MXNET_LIVE", int, 1, "x.py", "still read", False),
+            Knob("MXNET_DEAD", int, 1, "gone.py", "refactored", False),
+            Knob("MXNET_INERT", int, 1, "(subsumed)", "PJRT owns it",
+                 True),
+        ]
+        """))
+    (pkg / "x.py").write_text(textwrap.dedent("""
+        import os
+        v = os.environ.get("MXNET_LIVE", "1")
+        """))
+    (tmp_path / "README.md").write_text(
+        "| `MXNET_LIVE` | x | `MXNET_DEAD` | x | `MXNET_INERT` | x |\n")
+    return tmp_path
+
+
+class TestStaleKnob:
+    def test_dead_knob_fires_subsumed_exempt(self, stale_project):
+        res = run_suite([str(stale_project / "mxnet_tpu")],
+                        checks=["stale-knob"], root=str(stale_project))
+        assert checks_of(res) == ["stale-knob"]
+        assert "MXNET_DEAD" in res.findings[0].message
+        assert res.findings[0].path == "mxnet_tpu/env.py"
+
+    def test_read_anywhere_in_tree_counts(self, stale_project):
+        # a knob read only by a driver under tools/ is NOT stale — the
+        # scan covers the whole project regardless of the run's paths
+        tools = stale_project / "tools"
+        tools.mkdir()
+        (tools / "drv.py").write_text(textwrap.dedent("""
+            import os
+            v = os.environ.get("MXNET_DEAD")
+            """))
+        res = run_suite([str(stale_project / "mxnet_tpu")],
+                        checks=["stale-knob"], root=str(stale_project))
+        assert res.findings == []
+
+    def test_justified_suppression_on_knob_line(self, stale_project):
+        env_py = stale_project / "mxnet_tpu" / "env.py"
+        src = env_py.read_text().replace(
+            '"refactored", False),',
+            '"refactored", False),  '
+            '# mxlint: disable=stale-knob -- forward declaration')
+        env_py.write_text(src)
+        res = run_suite([str(stale_project / "mxnet_tpu")],
+                        checks=["stale-knob"], root=str(stale_project))
+        assert res.findings == []
+        assert res.suppressed == 1
+
+    def test_suppression_honored_outside_scanned_paths(self, stale_project):
+        """Cross-module findings anchor to env.py even when env.py is
+        NOT among the linted paths — its justified suppressions must
+        still apply (run() parses the anchor file on demand)."""
+        env_py = stale_project / "mxnet_tpu" / "env.py"
+        src = env_py.read_text().replace(
+            '"refactored", False),',
+            '"refactored", False),  '
+            '# mxlint: disable=stale-knob -- forward declaration')
+        env_py.write_text(src)
+        tools = stale_project / "tools"
+        tools.mkdir()
+        (tools / "t.py").write_text("x = 1\n")
+        res = run_suite([str(tools)], checks=["stale-knob"],
+                        root=str(stale_project))
+        assert res.findings == []
+        assert res.suppressed == 1
+
+
 class TestEnvKnob:
     def test_undeclared_read_fires(self, knob_project):
         res = lint(knob_project, """

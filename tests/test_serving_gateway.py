@@ -1,0 +1,697 @@
+"""mxnet_tpu.serving.gateway — multi-model inference gateway (ISSUE 15
+tentpole): registry + fair-share scheduling + deadline classes +
+SLO-coupled shedding + per-model readiness + quantized/mesh-sharded
+backends + zero-drop hot reload. Every gateway is shut down in a
+finally/with; model names are minted per test so the process-global
+registry families never blend across tests."""
+import gc
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+from mxnet_tpu.serving import (DeadlineExceededError, GatewayResult,
+                               ModelGateway, ModelSpec, QueueFullError,
+                               ServiceUnavailableError, hot_swap)
+from mxnet_tpu.serving import gateway as gwmod
+
+_names = itertools.count()
+
+
+def _name(base="m"):
+    return "%s%d" % (base, next(_names))
+
+
+_W = None
+
+
+def _weight():
+    global _W
+    if _W is None:
+        _W = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    return _W
+
+
+def _dot(w, x):
+    return mx.nd.dot(x, w)
+
+
+def _spec(name, w=None, **kw):
+    kw.setdefault("item_shape", (4,))
+    kw.setdefault("max_batch", 8)
+    return ModelSpec(name, fn=_dot,
+                     params=[w if w is not None else _weight()], **kw)
+
+
+# -- spec / registry validation ---------------------------------------------
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        ModelSpec("x", item_shape=(4,))                    # no source
+    with pytest.raises(ValueError):
+        ModelSpec("x", fn=_dot, checkpoint="p", item_shape=(4,))
+    with pytest.raises(ValueError):
+        ModelSpec("x", fn=_dot, item_shape=(4,), quantize="fp8")
+    with pytest.raises(ValueError):
+        ModelSpec("x", checkpoint="p", item_shape=(4,), quantize="int8")
+    with pytest.raises(ValueError):
+        ModelSpec("x", checkpoint="p", item_shape=(4,),
+                  mesh_axes={"tp": 2})
+    with pytest.raises(ValueError):
+        ModelSpec("x", fn=_dot, item_shape=(4,), weight=0)
+    with pytest.raises(ValueError):
+        ModelSpec("x", fn=_dot, item_shape=(4,), deadline_classes=())
+    with pytest.raises(ValueError):
+        ModelSpec("x", fn=_dot, item_shape=(4,),
+                  deadline_classes=(("a", 1), ("a", 2)))
+
+
+def test_registry_dup_and_unknown():
+    gw = ModelGateway(start=False)
+    try:
+        a = _name()
+        gw.register(_spec(a), warmup=False)
+        with pytest.raises(ValueError):
+            gw.register(_spec(a), warmup=False)
+        with pytest.raises(KeyError):
+            gw.predict(_name("ghost"), np.ones((1, 4), np.float32))
+        desc = gw.registry.describe()
+        assert desc[a]["generation"] == 1
+        assert desc[a]["buckets"] == [1, 2, 4, 8]
+    finally:
+        gw.shutdown()
+
+
+# -- two models, one pool ----------------------------------------------------
+
+def test_two_models_serve_independently():
+    gw = ModelGateway()
+    try:
+        a, b = _name("a"), _name("b")
+        gw.register(_spec(a))
+        gw.register(_spec(b, w=_weight() * 2))
+        x = np.random.rand(2, 4).astype(np.float32)
+        ra = gw.predict(a, x)
+        rb = gw.predict(b, x)
+        assert isinstance(ra, GatewayResult)
+        assert ra.model == a and ra.generation == 1
+        w = _weight().asnumpy()
+        np.testing.assert_allclose(ra.output.asnumpy(), x @ w, rtol=1e-5)
+        np.testing.assert_allclose(rb.output.asnumpy(), x @ (2 * w),
+                                   rtol=1e-5)
+        st = gw.stats()
+        assert st[a]["buckets"][2]["batches"] == 1
+        assert st[b]["generation"] == 1 and st[b]["ready"]
+    finally:
+        gw.shutdown()
+
+
+def test_concurrent_submits_coalesce_per_model():
+    """17 batch-1 submits per model coalesce into <= ceil(17/8) device
+    calls EACH, and no batch ever mixes models (every result decodes
+    with its own model's weights)."""
+    gw = ModelGateway()
+    try:
+        a, b = _name("a"), _name("b")
+        gw.register(_spec(a))
+        gw.register(_spec(b, w=_weight() * 3))
+        gw.pause()
+        xs = [np.random.rand(1, 4).astype(np.float32) for _ in range(17)]
+        futs_a = [gw.submit(a, x) for x in xs]
+        futs_b = [gw.submit(b, x) for x in xs]
+        gw.resume()
+        w = _weight().asnumpy()
+        for x, f in zip(xs, futs_a):
+            np.testing.assert_allclose(f.result(timeout=30).output.asnumpy(),
+                                       x @ w, rtol=1e-5)
+        for x, f in zip(xs, futs_b):
+            np.testing.assert_allclose(f.result(timeout=30).output.asnumpy(),
+                                       x @ (3 * w), rtol=1e-5)
+        st = gw.stats()
+        for m in (a, b):
+            calls = sum(v["batches"] for v in st[m]["buckets"].values())
+            assert calls <= -(-17 // 8), \
+                "%s: 17 singles took %d device calls" % (m, calls)
+    finally:
+        gw.shutdown()
+
+
+class _Recorder:
+    """Wraps a backend to record dispatch order (the worker snapshots
+    st.backend per batch, so wrapping between pause/resume is safe)."""
+
+    def __init__(self, inner, name, log):
+        self._inner = inner
+        self._name = name
+        self._log = log
+
+    def __call__(self, batch):
+        self._log.append(self._name)
+        return self._inner(batch)
+
+    @property
+    def compile_count(self):
+        return self._inner.compile_count
+
+
+def test_fair_share_weighted_round_robin():
+    """Weights 3:1 — with both queues busy the smooth-WRR pick sequence
+    serves a and b 3:1 deterministically; a hot model cannot starve the
+    other."""
+    gw = ModelGateway(max_queue=64)
+    try:
+        a, b = _name("a"), _name("b")
+        gw.register(_spec(a, weight=3.0))
+        gw.register(_spec(b, weight=1.0))
+        log = []
+        gw._state(a).backend = _Recorder(gw._state(a).backend, "a", log)
+        gw._state(b).backend = _Recorder(gw._state(b).backend, "b", log)
+        gw.pause()
+        x = np.ones((8, 4), np.float32)   # full bucket -> one dispatch each
+        futs = [gw.submit(a, x) for _ in range(12)] \
+            + [gw.submit(b, x) for _ in range(4)]
+        gw.resume()
+        for f in futs:
+            f.result(timeout=30)
+        assert log.count("a") == 12 and log.count("b") == 4
+        # b is served at its 1-in-4 share from the start, not last:
+        assert "b" in log[:4], log
+    finally:
+        gw.shutdown()
+
+
+def test_global_admission_pool_bound():
+    gw = ModelGateway(max_queue=4)
+    try:
+        a, b = _name("a"), _name("b")
+        gw.register(_spec(a))
+        gw.register(_spec(b))
+        gw.pause()
+        futs = [gw.submit(a, np.ones((1, 4), np.float32)) for _ in range(2)]
+        futs += [gw.submit(b, np.ones((1, 4), np.float32))
+                 for _ in range(2)]
+        # The POOL is full: either model's next request sheds.
+        with pytest.raises(QueueFullError):
+            gw.submit(b, np.ones((1, 4), np.float32))
+        gw.resume()
+        for f in futs:
+            assert f.result(timeout=30).output.shape == (1, 3)
+        assert gw.stats()[b]["shed"].get("queue_full:default") == 1
+    finally:
+        gw.shutdown()
+
+
+# -- deadline classes --------------------------------------------------------
+
+def test_deadline_classes():
+    gw = ModelGateway()
+    try:
+        a = _name()
+        gw.register(_spec(a, deadline_classes=(("interactive", 30),
+                                               ("batch", None))))
+        with pytest.raises(ValueError):
+            gw.submit(a, np.ones((1, 4), np.float32),
+                      deadline_class="nope")
+        gw.pause()
+        doomed = gw.submit(a, np.ones((1, 4), np.float32),
+                           deadline_class="interactive")
+        survivor = gw.submit(a, np.ones((1, 4), np.float32),
+                             deadline_class="batch")
+        time.sleep(0.08)
+        gw.resume()
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=30)
+        assert survivor.result(timeout=30).output.shape == (1, 3)
+        assert gw.stats()[a]["shed"].get("deadline:interactive") == 1
+        # explicit timeout_ms overrides the class deadline
+        assert gw.predict(a, np.ones((1, 4), np.float32),
+                          deadline_class="interactive",
+                          timeout_ms=5000).output.shape == (1, 3)
+    finally:
+        gw.shutdown()
+
+
+# -- SLO-coupled shedding ----------------------------------------------------
+
+def test_slo_burn_sheds_lowest_class_only():
+    """While a model's burn rate exceeds budget, admission sheds ITS
+    lowest deadline class; higher classes and other models admit
+    normally — and shedding clears when the burn subsides."""
+    clk = {"t": 0.0}
+    gw = ModelGateway(burn_windows=(1.0, 5.0), eval_interval_s=0.01,
+                      shed_burn_rate=2.0, clock=lambda: clk["t"])
+    try:
+        hot, steady = _name("hot"), _name("steady")
+        gw.register(_spec(hot, slo=(0.9, 0.001),
+                          deadline_classes=(("interactive", None),
+                                            ("best_effort", None))))
+        gw.register(_spec(steady))
+        lat = gwmod._gw_latency.labels(model=hot)
+        gw._burn_tick()                      # baseline sample at t=0
+        for _ in range(20):
+            lat.observe(0.5)                 # every event blows the SLO
+        clk["t"] = 0.5
+        gw._burn_tick()
+        assert gw.stats()[hot]["shedding"]
+        with pytest.raises(ServiceUnavailableError):
+            gw.submit(hot, np.ones((1, 4), np.float32),
+                      deadline_class="best_effort")
+        # higher class still admits; the other model is untouched
+        assert gw.predict(hot, np.ones((1, 4), np.float32),
+                          deadline_class="interactive").output.shape \
+            == (1, 3)
+        assert gw.predict(steady,
+                          np.ones((1, 4), np.float32)).output.shape \
+            == (1, 3)
+        assert gw.stats()[hot]["shed"].get("slo_burn:best_effort", 0) >= 1
+        # recovery: good traffic + time -> shedding clears
+        for _ in range(200):
+            lat.observe(0.0)
+        clk["t"] = 2.5
+        gw._burn_tick()
+        assert not gw.stats()[hot]["shedding"]
+        assert gw.predict(hot, np.ones((1, 4), np.float32),
+                          deadline_class="best_effort").output.shape \
+            == (1, 3)
+        # unregister drops the SLO's emitted burn-rate series too
+        from mxnet_tpu.telemetry import metrics as tm
+
+        gw.unregister(hot)
+        fam = tm.REGISTRY.get("mx_slo_burn_rate")
+        assert not [v for v, _ in fam.collect()
+                    if v[0] == "gateway_%s" % hot]
+    finally:
+        gw.shutdown()
+
+
+# -- per-model readiness (ISSUE 15 satellite) --------------------------------
+
+def test_readiness_is_per_model():
+    """A model mid-warmup sheds 503 for ITSELF only; other models keep
+    serving (the server-global shed_unready fix), and unregister
+    releases the model's readiness slot."""
+    from mxnet_tpu.telemetry import healthplane as hp
+
+    hp.reset()
+    try:
+        gw = ModelGateway()
+        try:
+            a, cold = _name("a"), _name("cold")
+            gw.register(_spec(a))
+            gw.register(_spec(cold), warmup=False)
+            comp = "gateway/%s" % cold
+            assert hp.readiness()[comp] is False
+            with pytest.raises(ServiceUnavailableError):
+                gw.submit(cold, np.ones((1, 4), np.float32))
+            # model a serves fine DESPITE the pod-level /readyz being
+            # false — readiness is per model at the gateway
+            assert not hp.is_ready()
+            assert gw.predict(a, np.ones((1, 4), np.float32)) \
+                .output.shape == (1, 3)
+            assert gw.stats()[cold]["shed"].get("unready:default") == 1
+            gw.warmup(cold)
+            assert hp.readiness()[comp] is True
+            assert gw.predict(cold, np.ones((1, 4), np.float32)) \
+                .output.shape == (1, 3)
+            gw.unregister(cold)
+            assert comp not in hp.readiness()   # slot RELEASED
+            assert hp.is_ready()
+        finally:
+            gw.shutdown()
+        # shutdown releases the remaining model slots too
+        assert not [c for c in hp.readiness() if c.startswith("gateway/")]
+    finally:
+        hp.reset()
+
+
+def test_unregister_fails_queued_and_drops_series():
+    gw = ModelGateway()
+    try:
+        a = _name()
+        gw.register(_spec(a))
+        gw.pause()
+        fut = gw.submit(a, np.ones((1, 4), np.float32))
+        gw.unregister(a)
+        gw.resume()
+        with pytest.raises(ServiceUnavailableError):
+            fut.result(timeout=5)
+        assert a not in gw.models()
+        assert a not in gw.stats()
+        # labeled series left the registry families
+        assert not [v for v, _ in gwmod._gw_requests.collect()
+                    if v[0] == a]
+        # re-registering the same name works (SLO slot freed too)
+        gw.register(_spec(a, slo=(0.99, 0.25)))
+        assert gw.predict(a, np.ones((1, 4), np.float32)) \
+            .output.shape == (1, 3)
+    finally:
+        gw.shutdown()
+
+
+# -- quantized bucket ladders ------------------------------------------------
+
+def test_quantized_int8_backend():
+    rng = np.random.RandomState(0)
+    w = mx.nd.array(rng.randn(16, 8).astype(np.float32))
+    gw = ModelGateway()
+    try:
+        q = _name("q8")
+        gw.register(ModelSpec(q, fn=_dot, params=[w], item_shape=(16,),
+                              max_batch=4, quantize="int8"))
+        st = gw._state(q)
+        # the executable's weights ARE int8 (weight-only quantization)
+        assert str(st.backend._params[0].dtype) == "int8"
+        x = rng.rand(3, 16).astype(np.float32)
+        ref = x @ w.asnumpy()
+        out = gw.predict(q, x).output.asnumpy()
+        assert out.dtype == np.float32
+        assert np.max(np.abs(out - ref)) <= 0.05 * np.max(np.abs(ref))
+        # warmed ladder: later traffic compiles nothing
+        n = st.backend.compile_count
+        gw.predict(q, x)
+        assert st.backend.compile_count == n == len(st.spec.policy.buckets)
+    finally:
+        gw.shutdown()
+
+
+def test_quantized_bf16_backend():
+    rng = np.random.RandomState(1)
+    w = mx.nd.array(rng.randn(16, 8).astype(np.float32))
+    gw = ModelGateway()
+    try:
+        b = _name("b16")
+        gw.register(ModelSpec(b, fn=_dot, params=[w], item_shape=(16,),
+                              max_batch=4, quantize="bf16"))
+        assert str(gw._state(b).backend._params[0].dtype) == "bfloat16"
+        x = rng.rand(3, 16).astype(np.float32)
+        ref = x @ w.asnumpy()
+        out = gw.predict(b, x).output.asnumpy()
+        assert out.dtype == np.float32   # cast back at the boundary
+        assert np.max(np.abs(out - ref)) <= 0.05 * np.max(np.abs(ref))
+    finally:
+        gw.shutdown()
+
+
+# -- mesh-sharded serving ----------------------------------------------------
+
+def test_mesh_sharded_model_single_process():
+    """Bucket executables compiled over a 2-device tp mesh: params are
+    REALLY sharded (2 addressable shards on 2 devices), results match
+    the unsharded reference."""
+    rng = np.random.RandomState(2)
+    w = mx.nd.array(rng.randn(16, 8).astype(np.float32))
+    gw = ModelGateway()
+    try:
+        m = _name("mesh")
+        gw.register(ModelSpec(m, fn=_dot, params=[w], item_shape=(16,),
+                              max_batch=4, mesh_axes={"tp": 2}))
+        st = gw._state(m)
+        pv = st.backend._param_vals[0]
+        shards = pv.addressable_shards
+        assert len(shards) == 2
+        assert len({s.device for s in shards}) == 2
+        assert shards[0].data.shape == (8, 8)     # dim0 split over tp
+        x = rng.rand(3, 16).astype(np.float32)
+        out = gw.predict(m, x).output.asnumpy()
+        np.testing.assert_allclose(out, x @ w.asnumpy(), rtol=1e-5)
+        assert st.backend.compile_count == len(st.spec.policy.buckets)
+    finally:
+        gw.shutdown()
+
+
+# -- hot reload --------------------------------------------------------------
+
+def test_hot_swap_bumps_generation_and_bit_matches():
+    gw = ModelGateway()
+    try:
+        a = _name()
+        gw.register(_spec(a))
+        x = np.random.rand(2, 4).astype(np.float32)
+        r1 = gw.predict(a, x)
+        assert r1.generation == 1
+        w2 = _weight() * 5
+        gen = hot_swap(gw, a, params=[w2])
+        assert gen == 2 == gw.registry.describe()[a]["generation"]
+        r2 = gw.predict(a, x)
+        assert r2.generation == 2
+        # post-swap responses bit-match a FRESH load of the new weights
+        fresh = gw.registry.spec(a).build_backend(params=[w2])
+        want = fresh(mx.nd.array(np.vstack([x, np.zeros((2, 4),
+                                                        np.float32)])))
+        np.testing.assert_array_equal(r2.output.asnumpy(),
+                                      want.asnumpy()[:2])
+    finally:
+        gw.shutdown()
+
+
+def test_hot_swap_under_fire_zero_drops():
+    """ISSUE 15 satellite: concurrent requests hammering the gateway
+    across a mid-run swap() — zero QueueFullError/dropped futures, no
+    cross-version batch (every response tagged exactly one
+    generation), and the old backend (its whole executable cache) is
+    released after drain."""
+    gw = ModelGateway(max_queue=10000, max_delay_ms=1.0)
+    try:
+        a = _name()
+        gw.register(_spec(a))
+        old_ref = weakref.ref(gw._state(a).backend)
+        stop = threading.Event()
+        results, errors = [], []
+
+        def hammer():
+            x = np.random.rand(1, 4).astype(np.float32)
+            while not stop.is_set():
+                try:
+                    results.append(gw.predict(a, x))
+                except Exception as exc:   # any shed/drop fails the test
+                    errors.append(exc)
+
+        threads = [threading.Thread(target=hammer) for _ in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        gen = hot_swap(gw, a, params=[_weight() * 7])
+        time.sleep(0.15)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors[:3]
+        assert len(results) > 0
+        gens = {r.generation for r in results}
+        assert gens <= {1, 2} and 2 in gens, gens
+        assert all(isinstance(r.generation, int) for r in results)
+        assert gen == 2
+        # old executables released after drain
+        gc.collect()
+        assert old_ref() is None, "old backend still referenced"
+    finally:
+        gw.shutdown()
+
+
+def test_hot_swap_checkpoint_model(tmp_path):
+    """Checkpoint-backed model: register epoch 0, hot swap to epoch 1;
+    post-swap responses bit-match a fresh load of the new checkpoint."""
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="gwfc")
+    rng = np.random.RandomState(3)
+    prefix = str(tmp_path / "gwmlp")
+    for epoch in (0, 1):
+        args = {"gwfc_weight": mx.nd.array(rng.randn(3, 4)
+                                           .astype(np.float32)),
+                "gwfc_bias": mx.nd.array(rng.randn(3)
+                                         .astype(np.float32))}
+        mx.model.save_checkpoint(prefix, epoch, net, args, {})
+
+    gw = ModelGateway()
+    try:
+        c = _name("ckpt")
+        spec = ModelSpec(c, checkpoint=prefix, epoch=0, item_shape=(4,),
+                         max_batch=4)
+        gw.register(spec)
+        x = np.random.rand(2, 4).astype(np.float32)
+        r1 = gw.predict(c, x)
+        with pytest.raises(ValueError):
+            hot_swap(gw, c, params=[_weight()])   # wrong source kind
+        gen = hot_swap(gw, c, checkpoint=True, epoch=1)
+        assert gen == 2
+        r2 = gw.predict(c, x)
+        assert not np.array_equal(r1.output.asnumpy(),
+                                  r2.output.asnumpy())
+        fresh = spec.build_backend(checkpoint=prefix, epoch=1)
+        want = fresh(mx.nd.array(x))
+        np.testing.assert_array_equal(r2.output.asnumpy(), want.asnumpy())
+    finally:
+        gw.shutdown()
+
+
+def test_hot_swap_from_checkpoint_manager(tmp_path):
+    """The training-commits-flow-into-serving path: restore() through a
+    CheckpointManager, extract serving params, zero-drop swap."""
+    from mxnet_tpu import checkpoint
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "ckpt"),
+                                       keep_last=2)
+    try:
+        w2 = (_weight() * 9).asnumpy()
+        mgr.save(7, {"w": w2}, sync=True)
+        gw = ModelGateway()
+        try:
+            a = _name()
+            gw.register(_spec(a))
+            with pytest.raises(ValueError):
+                hot_swap(gw, a, manager=mgr)      # extract= required
+            gen = hot_swap(
+                gw, a, manager=mgr,
+                extract=lambda state: [mx.nd.array(state["w"])])
+            assert gen == 2
+            x = np.random.rand(1, 4).astype(np.float32)
+            out = gw.predict(a, x).output.asnumpy()
+            np.testing.assert_allclose(out, x @ w2, rtol=1e-5)
+        finally:
+            gw.shutdown()
+    finally:
+        mgr.close()
+
+
+# -- lifecycle hygiene -------------------------------------------------------
+
+def test_shutdown_drains_and_rejects_new():
+    gw = ModelGateway()
+    a = _name()
+    gw.register(_spec(a))
+    gw.pause()
+    futs = [gw.submit(a, np.ones((1, 4), np.float32)) for _ in range(3)]
+    gw.shutdown(drain=True)
+    for f in futs:
+        assert f.result(timeout=1).output.shape == (1, 3)
+    with pytest.raises(RuntimeError):
+        gw.submit(a, np.ones((1, 4), np.float32))
+
+
+def test_shutdown_without_drain_fails_pending():
+    gw = ModelGateway()
+    a = _name()
+    gw.register(_spec(a))
+    gw.pause()
+    fut = gw.submit(a, np.ones((1, 4), np.float32))
+    gw.shutdown(drain=False)
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=1)
+
+
+def test_register_after_shutdown_leaves_no_ghosts():
+    """A refused registration must unwind every side effect: no ghost
+    registry entry, no permanently not-ready /readyz component."""
+    from mxnet_tpu.telemetry import healthplane as hp
+
+    hp.reset()
+    try:
+        gw = ModelGateway(start=False)
+        gw.shutdown()
+        a = _name()
+        with pytest.raises(RuntimeError):
+            gw.register(_spec(a, slo=(0.99, 0.25)))
+        assert a not in gw.registry.names()
+        assert not [c for c in hp.readiness()
+                    if c.startswith("gateway/")]
+        assert hp.is_ready()
+    finally:
+        hp.reset()
+
+
+def test_request_validation():
+    gw = ModelGateway(start=False)
+    try:
+        a = _name()
+        gw.register(_spec(a))
+        with pytest.raises(ValueError):
+            gw.submit(a, np.ones((1, 5), np.float32))    # wrong shape
+        with pytest.raises(ValueError):
+            gw.submit(a, np.ones((9, 4), np.float32))    # > max_batch
+    finally:
+        gw.shutdown()
+
+
+def test_worker_thread_daemonized():
+    gw = ModelGateway()
+    try:
+        assert gw._thread.daemon
+        assert any(t.name == "mx-serving-gateway"
+                   for t in threading.enumerate())
+    finally:
+        gw.shutdown()
+
+
+def test_two_process_mesh_gateway_acceptance(tmp_path):
+    """ISSUE 15 acceptance: 2 processes x 1 CPU device form one 2-device
+    tp mesh; each rank's gateway serves a mesh-sharded model in
+    lockstep (each process holds ONE weight shard) while rank 0 also
+    hammers an int8-quantized local model across a mid-run hot swap fed
+    by a CheckpointManager commit — zero dropped requests, both
+    generations observed, post-swap responses bit-match a fresh load of
+    the new checkpoint. All assertions live in the prog; this test
+    checks the exit codes and the rank-0 report."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from launch import launch_local
+
+    prog = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "gateway_mesh_prog.py")
+    out = str(tmp_path / "report.json")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DMLC_")}
+    env["XLA_FLAGS"] = ""       # override conftest's 8-device force
+    env["JAX_PLATFORMS"] = ""   # prog pins cpu itself
+    codes = launch_local(2, 0, [sys.executable, prog, out],
+                         env_extra=env, timeout=240)
+    assert codes == [0, 0], codes
+    with open(out) as f:
+        report = json.load(f)
+    assert report["errors"] == []
+    assert report["mesh_requests"] == 20
+    assert report["addressable_shards"] == 1     # sharded ACROSS ranks
+    assert report["quant_dropped"] == 0
+    assert report["generations"] == [1, 2]
+    assert report["quant_requests"] > 0
+
+
+def test_mixed_load_many_threads():
+    """Stress shape of the acceptance: 2 models (one quantized), mixed
+    concurrent load, every response correct for ITS model and tagged
+    with the serving generation."""
+    rng = np.random.RandomState(4)
+    w = mx.nd.array(rng.randn(4, 3).astype(np.float32))
+    gw = ModelGateway(max_queue=4096)
+    try:
+        a, q = _name("a"), _name("q")
+        gw.register(_spec(a, w=w))
+        gw.register(ModelSpec(q, fn=_dot, params=[w * 2], item_shape=(4,),
+                              max_batch=8, quantize="int8"))
+        xs = [rng.rand(rng.randint(1, 4), 4).astype(np.float32)
+              for _ in range(60)]
+        with ThreadPoolExecutor(12) as pool:
+            futs_a = [pool.submit(gw.predict, a, x) for x in xs]
+            futs_q = [pool.submit(gw.predict, q, x) for x in xs]
+            res_a = [f.result(timeout=60) for f in futs_a]
+            res_q = [f.result(timeout=60) for f in futs_q]
+        wn = w.asnumpy()
+        for x, r in zip(xs, res_a):
+            assert r.model == a and r.generation == 1
+            np.testing.assert_allclose(r.output.asnumpy(), x @ wn,
+                                       rtol=1e-5)
+        for x, r in zip(xs, res_q):
+            ref = x @ (2 * wn)
+            assert np.max(np.abs(r.output.asnumpy() - ref)) \
+                <= 0.05 * max(np.max(np.abs(ref)), 1e-6)
+    finally:
+        gw.shutdown()

@@ -1,6 +1,6 @@
 """mxlint — project-aware static analysis for mxnet_tpu.
 
-Six AST-based checkers (stdlib only), each machine-checking an
+Seven AST-based checkers (stdlib only), each machine-checking an
 invariant a past regression taught us to enforce::
 
     python -m tools.mxlint mxnet_tpu/                 # full suite

@@ -8,6 +8,7 @@ documents the how-to).
 from .envknobs import EnvKnobChecker
 from .locks import LockChecker
 from .signals import SignalChecker
+from .staleknobs import StaleKnobChecker
 from .telemetry_names import TelemetryNameChecker
 from .threads import ThreadChecker
 from .writes import WriteChecker
@@ -18,6 +19,7 @@ ALL_CHECKERS = (
     SignalChecker,
     WriteChecker,
     EnvKnobChecker,
+    StaleKnobChecker,
     ThreadChecker,
     TelemetryNameChecker,
 )
@@ -30,6 +32,7 @@ CHECKS = {
     "signal-safety": SignalChecker,
     "atomic-write": WriteChecker,
     "env-knob": EnvKnobChecker,
+    "stale-knob": StaleKnobChecker,
     "thread-lifecycle": ThreadChecker,
     "telemetry-naming": TelemetryNameChecker,
 }

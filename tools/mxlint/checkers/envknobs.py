@@ -26,6 +26,25 @@ _KNOB = re.compile(r"^(MXNET|DMLC)_[A-Z0-9_]+$")
 _READERS = {"get", "getenv", "get_env", "pop", "setdefault"}
 
 
+def knob_reads(node):
+    """Yield (knob-name, line) for env-read call/subscript nodes —
+    shared by the env-knob (undeclared-read) and stale-knob
+    (declared-but-unread) rules so both see the same read sites."""
+    if isinstance(node, ast.Call):
+        name = dotted(node.func) or ""
+        if name.split(".")[-1] in _READERS and node.args:
+            a = node.args[0]
+            if (isinstance(a, ast.Constant) and isinstance(a.value, str)
+                    and _KNOB.match(a.value)):
+                yield a.value, node.lineno
+    elif isinstance(node, ast.Subscript):
+        base = dotted(node.value) or ""
+        s = node.slice
+        if (base.endswith("environ") and isinstance(s, ast.Constant)
+                and isinstance(s.value, str) and _KNOB.match(s.value)):
+            yield s.value, node.lineno
+
+
 class EnvKnobChecker(Checker):
     name = "env-knob"
     description = ("every MXNET_*/DMLC_* env read declared in env.py's "
@@ -49,21 +68,7 @@ class EnvKnobChecker(Checker):
                         "it" % name))
         return findings
 
-    def _knob_reads(self, node):
-        """Yield (knob-name, line) for env-read call/subscript nodes."""
-        if isinstance(node, ast.Call):
-            name = dotted(node.func) or ""
-            if name.split(".")[-1] in _READERS and node.args:
-                a = node.args[0]
-                if (isinstance(a, ast.Constant) and isinstance(a.value, str)
-                        and _KNOB.match(a.value)):
-                    yield a.value, node.lineno
-        elif isinstance(node, ast.Subscript):
-            base = dotted(node.value) or ""
-            s = node.slice
-            if (base.endswith("environ") and isinstance(s, ast.Constant)
-                    and isinstance(s.value, str) and _KNOB.match(s.value)):
-                yield s.value, node.lineno
+    _knob_reads = staticmethod(knob_reads)
 
     def _check_catalogue(self, mod):
         """On env.py itself: every declared knob must appear in the

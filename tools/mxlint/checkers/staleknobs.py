@@ -1,0 +1,75 @@
+"""Checker: stale env knobs (declared but read nowhere).
+
+The dual of the env-knob rule: that one catches READS missing from the
+catalogue; this one catches CATALOGUE entries (and therefore README
+table rows) whose knob is no longer read anywhere in the tree — dead
+configuration surface. The failure mode it kills: a subsystem refactor
+drops the read site, the knob keeps rendering in ``env.describe()``,
+the README and every flight-recorder env dump, and operators keep
+setting a value that does nothing.
+
+Scope: read sites are collected from the WHOLE project (``mxnet_tpu/``,
+``tools/``, ``examples/``, ``tests/``, ``benchmark/``, ``bench.py``)
+regardless of which paths the current run was given — a knob read only
+by a driver or a test is configuration surface, not dead. Knobs
+declared ``subsumed=True`` are accepted-but-inert by design and exempt.
+Findings anchor to the knob's ``Knob(...)`` line in ``env.py``, so a
+deliberate forward declaration can carry a justified suppression there.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Checker, Finding, iter_py_files
+from .envknobs import knob_reads
+
+# Project roots scanned for read sites (relative to the repo root).
+SCAN_ROOTS = ("mxnet_tpu", "tools", "examples", "tests", "benchmark",
+              "bench.py")
+
+
+class StaleKnobChecker(Checker):
+    name = "stale-knob"
+    description = ("every non-subsumed knob in env.py's CATALOGUE is "
+                   "still read somewhere in the tree")
+
+    def begin_project(self, ctx):
+        self._ctx = ctx
+
+    def _project_reads(self):
+        """Every knob name with a literal read site anywhere under the
+        project roots (one AST pass per file; env.py itself declares,
+        it does not read)."""
+        reads = set()
+        roots = [os.path.join(self._ctx.root, r) for r in SCAN_ROOTS]
+        for path in iter_py_files([r for r in roots if os.path.exists(r)]):
+            if self._ctx.env_py and \
+                    os.path.normpath(path) == self._ctx.env_py:
+                continue
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError, ValueError):
+                continue
+            for node in ast.walk(tree):
+                for name, _ in knob_reads(node):
+                    reads.add(name)
+        return reads
+
+    def finalize(self):
+        ctx = self._ctx
+        if not ctx.env_py or not ctx.catalogue:
+            return ()
+        reads = self._project_reads()
+        rel = os.path.relpath(ctx.env_py, ctx.root).replace(os.sep, "/")
+        findings = []
+        for name, line in sorted(ctx.catalogue_lines.items()):
+            if name in reads or ctx.catalogue_subsumed.get(name):
+                continue
+            findings.append(Finding(
+                rel, line, self.name,
+                "knob %r is declared in CATALOGUE but read nowhere in "
+                "the tree — prune it (and its README row) or re-wire "
+                "the read site the refactor dropped" % name))
+        return findings

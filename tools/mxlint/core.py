@@ -100,6 +100,7 @@ class ProjectContext:
         self.root = root
         self.catalogue = set()      # declared env knobs (name strings)
         self.catalogue_lines = {}   # name -> line in env.py
+        self.catalogue_subsumed = {}  # name -> bool (accepted-but-inert)
         self.env_py = None
         self.readme_names = set()   # MXNET_*/DMLC_* tokens in README.md
         env_py = os.path.join(root, "mxnet_tpu", "env.py") if root else None
@@ -115,6 +116,19 @@ class ProjectContext:
                         and isinstance(node.args[0].value, str)):
                     self.catalogue.add(node.args[0].value)
                     self.catalogue_lines[node.args[0].value] = node.lineno
+                    # Knob(name, typ, default, where, doc, subsumed) —
+                    # the subsumed flag is the 6th positional (or the
+                    # keyword); subsumed knobs are accepted-but-inert
+                    # by design and exempt from staleness.
+                    subsumed = False
+                    if len(node.args) >= 6 and \
+                            isinstance(node.args[5], ast.Constant):
+                        subsumed = bool(node.args[5].value)
+                    for kw in node.keywords:
+                        if kw.arg == "subsumed" and \
+                                isinstance(kw.value, ast.Constant):
+                            subsumed = bool(kw.value.value)
+                    self.catalogue_subsumed[node.args[0].value] = subsumed
         readme = os.path.join(root, "README.md") if root else None
         if readme and os.path.isfile(readme):
             with open(readme, "r", encoding="utf-8") as f:
@@ -204,8 +218,26 @@ def run(paths, checkers, root=None):
     for c in checkers:
         raw.extend(c.finalize())
     by_path = {m.relpath: m for m in mods}
+
+    def module_for(path):
+        """Suppression source for a finding's path. Cross-module rules
+        (stale-knob) may anchor findings to files OUTSIDE the scanned
+        paths (env.py); their justified suppressions must still count,
+        so the file is parsed on demand."""
+        mod = by_path.get(path)
+        if mod is None and root:
+            abspath = os.path.join(root, path)
+            if os.path.isfile(abspath):
+                try:
+                    with open(abspath, "r", encoding="utf-8") as fh:
+                        mod = ModuleInfo(abspath, path, fh.read())
+                except (OSError, SyntaxError, ValueError):
+                    mod = None
+            by_path[path] = mod
+        return mod
+
     for f in sorted(raw):
-        mod = by_path.get(f.path)
+        mod = module_for(f.path)
         sup = mod.suppressions.get(f.line) if mod else None
         if sup is not None:
             checks, justified = sup
