@@ -470,6 +470,70 @@ def _telemetry_dist_rows():
           "%")
 
 
+def _xtrace_rows():
+    """Causal-tracing section (ISSUE 18): what cross-process trace
+    propagation costs on the trainer step path. The SAME
+    ``gluon.Trainer`` loop (fused kvstore step: root context per step,
+    context-carrying reduce tasks, per-key spans) is timed with head
+    sampling OFF (``MXNET_TRACE_SAMPLE=0``: contexts still mint and
+    propagate — the designed cheap path — but stamp nothing) and ON
+    (rate 1.0 + trace-id exemplars: every span stamps
+    trace_id/parent_span_id, the production forensics configuration).
+    THE CONTRACT ROW: trace_propagation_step_overhead_pct <= 1%."""
+    import time as _t
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.telemetry import xtrace
+
+    rng = np.random.RandomState(7)
+    params = []
+    for k in range(300):
+        p = gluon.Parameter("xt_bench_%d" % k, shape=(1024,))
+        p.initialize(init=mx.init.Constant(0.0))
+        p.set_data(nd.array(rng.randn(1024).astype(np.float32)))
+        params.append(p)
+    trainer = gluon.Trainer(
+        params, "sgd", {"learning_rate": 0.05, "momentum": 0.9},
+        kvstore=kvs.KVStoreLocal(device_mode=True),
+        update_on_kvstore=False)
+    for p in params:
+        p.grad()[:] = rng.randn(1024).astype(np.float32)
+    trainer.step(1)                         # warmup: compile + init
+    params[-1].data().asnumpy()
+
+    iters = 30
+
+    def timed():
+        times = []
+        for _ in range(iters):
+            t0 = _t.perf_counter()
+            trainer.step(1)
+            params[-1].data().asnumpy()
+            times.append(_t.perf_counter() - t0)
+        return sorted(times)[len(times) // 2] * 1e3
+
+    prev_rate = xtrace.set_sample_rate(0.0)
+    try:
+        off_ms = timed()
+        xtrace.set_sample_rate(1.0)
+        xtrace.install_exemplars(True)
+        on_ms = timed()
+    finally:
+        xtrace.install_exemplars(False)
+        xtrace.set_sample_rate(prev_rate)
+
+    _emit("xtrace_step_ms_unsampled", round(off_ms, 3), "ms")
+    _emit("xtrace_step_ms_sampled", round(on_ms, 3), "ms")
+    # THE CONTRACT ROW: stamping every span with its trace context and
+    # recording trace-id exemplars must cost <= 1% of the step path.
+    # Negative values are measurement noise (the stamp is a dict
+    # setdefault against a ms-scale step).
+    _emit("trace_propagation_step_overhead_pct",
+          round((on_ms - off_ms) / off_ms * 100.0, 2), "%")
+
+
 def _diagnostics_rows():
     """Diagnostics section (ISSUE 7): what failure forensics costs when
     nothing is failing. THE CONTRACT ROWS:
@@ -1561,6 +1625,11 @@ def main():
         _telemetry_dist_rows()
     except Exception:
         print("bench telemetry_dist section failed:", file=sys.stderr)
+        traceback.print_exc()
+    try:
+        _xtrace_rows()
+    except Exception:
+        print("bench xtrace section failed:", file=sys.stderr)
         traceback.print_exc()
     try:
         _diagnostics_rows()

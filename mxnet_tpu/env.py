@@ -90,6 +90,19 @@ CATALOGUE = [
     Knob("MXNET_PROFILE_RETAIN", int, 20, "telemetry/profiling.py",
          "profile windows retained (ring; /debug/pprof?seconds=N can "
          "reach back window_s * retain seconds)", False),
+    Knob("MXNET_TRACE_SAMPLE", float, 1.0, "telemetry/xtrace.py",
+         "head-based trace sampling probability in [0, 1]: the keep/"
+         "drop coin is flipped ONCE per root context (xtrace.new_root) "
+         "and the decision propagates with the context", False),
+    Knob("MXNET_TRACE_DIR", str, "", "kvstore_server.py",
+         "when set, kvstore server processes stream their trace "
+         "segments here (trace.rank<R>.<seq>.jsonl, server ranks "
+         "numbered past the workers) so trace_merge can stitch server "
+         "apply spans into the pod timeline", False),
+    Knob("MXNET_XPROF_DIR", str, "", "telemetry/healthplane.py",
+         "capture root for POST /debug/xprof (jax.profiler.trace "
+         "output); default: <recorder dir>/xprof when a FlightRecorder "
+         "is attached to the health plane", False),
     Knob("MXNET_DATA_MAX_WORKERS", int, 16, "data/autoscale.py",
          "decode-pool autoscaling ceiling: DecodeAutoscaler never grows "
          "a pool past this many workers", False),

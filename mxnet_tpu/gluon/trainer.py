@@ -34,6 +34,7 @@ from .. import ndarray as nd
 from ..ndarray import sparse as _sp
 from ..telemetry import metrics as _tm
 from ..telemetry import trace as _trace
+from ..telemetry import xtrace as _xtrace
 from .parameter import ParameterDict
 
 __all__ = ["Trainer"]
@@ -80,7 +81,7 @@ class _ReduceTask:
     thread in submission order."""
 
     __slots__ = ("key", "flats", "register", "event", "error", "handle",
-                 "seconds", "inline_pull", "kv")
+                 "seconds", "inline_pull", "kv", "ctx")
 
     def __init__(self, key, flats, register=None, kv=None):
         self.key = key
@@ -92,12 +93,18 @@ class _ReduceTask:
         self.seconds = 0.0
         self.inline_pull = False
         self.kv = kv
+        # The step's trace context, captured where the task is BUILT
+        # (the stepping thread) and re-activated on the comm thread so
+        # the bucket's push/pull spans — and the wire context the dist
+        # store injects — belong to the step's trace, not the thread's.
+        self.ctx = _xtrace.current()
 
     def run(self, kv):
         t0 = time.perf_counter()
         try:
-            with _trace.span("trainer::allreduce", key=self.key,
-                             overlapped=True):
+            with _xtrace.activate(self.ctx), \
+                    _trace.span("trainer::allreduce", key=self.key,
+                                overlapped=True):
                 if self.register is not None:
                     self.register()
                 kv.push(self.key, self.flats)
@@ -281,6 +288,15 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce_grads + update (reference: trainer.py:step)."""
+        # The step is a trace head: under an existing context (a caller
+        # already rooted the step) keep it, else mint one — every span
+        # and kvstore wire message below then carries the step's trace.
+        ctx = _xtrace.current()
+        with _xtrace.activate(ctx if ctx is not None
+                              else _xtrace.new_root()):
+            self._step_traced(batch_size, ignore_stale_grad)
+
+    def _step_traced(self, batch_size, ignore_stale_grad=False):
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             # Init after rescale_grad is final: dist stores pickle the

@@ -40,6 +40,8 @@ from .kvstore import KVStoreLocal, PullHandle, _key_list, _val_list
 from .kvstore_server import _client
 from .ndarray import sparse as _sparse
 from .ndarray.ndarray import NDArray
+from .telemetry import trace as _trace
+from .telemetry import xtrace as _xtrace
 
 __all__ = ["KVStoreDist"]
 
@@ -240,9 +242,12 @@ class KVStoreDist(KVStoreLocal):
                         raise RuntimeError("kvstore server %d: %s"
                                            % (i, reply[1]))
 
-    def _call(self, server_idx, msg):
+    def _call(self, server_idx, msg, ctx_out=None):
         """Blocking RPC for value-bearing requests; retries once through
-        a reconnect if the server went away mid-exchange."""
+        a reconnect if the server went away mid-exchange. ``ctx_out``
+        (a list) collects the reply's trailing wire trace context, when
+        the server sent one (pull replies carry the context of the sync
+        round that produced the value)."""
         with self._comm_lock:
             self._drain_acks(server_idx)
             for attempt in (0, 1):
@@ -262,6 +267,8 @@ class KVStoreDist(KVStoreLocal):
             if reply[0] == "error":
                 raise RuntimeError("kvstore server %d: %s"
                                    % (server_idx, reply[1]))
+            if ctx_out is not None and len(reply) > 2:
+                ctx_out.append(reply[2])
             return reply[1] if len(reply) > 1 else None
 
     def _shards(self, key, shape, stype="default"):
@@ -308,7 +315,7 @@ class KVStoreDist(KVStoreLocal):
                 self._compression._residual.pop(subkey, None)
         if self._rank == 0:
             for sidx, subkey, _ in shards:
-                self._call(sidx, ("delete", subkey))
+                self._call(sidx, ("delete", subkey, _xtrace.inject()))
         self._barrier()
 
     def init(self, key, value):
@@ -325,7 +332,8 @@ class KVStoreDist(KVStoreLocal):
                 if self._rank == 0:
                     sidx, subkey, _ = self._shards(k, dense.shape,
                                                    "row_sparse")[0]
-                    self._call(sidx, ("init", subkey, dense))
+                    self._call(sidx, ("init", subkey, dense,
+                                      _xtrace.inject()))
                 continue
             arr = v.asnumpy()
             self._meta[k] = (arr.shape, arr.dtype, "default")
@@ -333,7 +341,8 @@ class KVStoreDist(KVStoreLocal):
                 flat = arr.reshape(-1)
                 for sidx, subkey, sl in self._shards(k, arr.shape):
                     part = arr if sl is None else flat[sl]
-                    self._call(sidx, ("init", subkey, part))
+                    self._call(sidx, ("init", subkey, part,
+                                      _xtrace.inject()))
         self._barrier()
 
     def push(self, key, value, priority=0):
@@ -355,9 +364,10 @@ class KVStoreDist(KVStoreLocal):
                 if self._compression is not None:
                     packed, meta = self._compression.compress(subkey, part)
                     self._post(sidx, ("push_compressed", subkey, packed,
-                                      meta))
+                                      meta, _xtrace.inject()))
                 else:
-                    self._post(sidx, ("push", subkey, part))
+                    self._post(sidx, ("push", subkey, part,
+                                      _xtrace.inject()))
 
     def _push_row_sparse(self, k, vlist):
         """Merge row_sparse device grads by concatenating (indices, values)
@@ -367,18 +377,39 @@ class KVStoreDist(KVStoreLocal):
                               for v in vlist])
         val = np.concatenate([v.data.asnumpy() for v in vlist])
         sidx, subkey, _ = self._shards(k, self._meta[k][0], "row_sparse")[0]
-        self._post(sidx, ("push_rsp", subkey, idx, val))
+        self._post(sidx, ("push_rsp", subkey, idx, val, _xtrace.inject()))
 
     def _fetch(self, k):
         shape, dtype, stype = self._meta[k]
         shards = self._shards(k, shape, stype)
+        t0 = time.perf_counter()
+        ctx_out = []
         if len(shards) == 1 and shards[0][2] is None:
-            return np.asarray(self._call(shards[0][0],
-                                         ("pull", shards[0][1]))).reshape(shape)
-        with self._comm_lock:
-            return self._fetch_sharded(k, shape, dtype, shards)
+            value = np.asarray(self._call(
+                shards[0][0], ("pull", shards[0][1], _xtrace.inject()),
+                ctx_out=ctx_out)).reshape(shape)
+        else:
+            with self._comm_lock:
+                value = self._fetch_sharded(k, shape, dtype, shards,
+                                            ctx_out)
+        self._pull_span(k, t0, ctx_out)
+        return value
 
-    def _fetch_sharded(self, k, shape, dtype, shards):
+    def _pull_span(self, k, t0, ctx_out):
+        """Record the pull as a trace slice. A reply carrying a FOREIGN
+        sampled round context (the peer whose push the server folded
+        first) is stamped as ``link_trace_id`` so trace_merge joins
+        this slice into that trace's cross-rank flow."""
+        args = {"key": str(k)}
+        rctx = next((c for c in map(_xtrace.extract, ctx_out)
+                     if c is not None), None)
+        own = _xtrace.current()
+        if rctx is not None and rctx.sampled and \
+                (own is None or own.trace_id != rctx.trace_id):
+            args["link_trace_id"] = rctx.trace_id
+        _trace.complete("kvstore::pull", t0, time.perf_counter(), **args)
+
+    def _fetch_sharded(self, k, shape, dtype, shards, ctx_out=None):
         # Big-array shards live one-per-server (contiguous slicing across
         # all servers): issue every shard pull first, then collect — the
         # servers serve and transfer concurrently instead of one
@@ -389,7 +420,8 @@ class KVStoreDist(KVStoreLocal):
         for sidx, subkey, sl in shards:
             self._drain_acks(sidx)
             try:
-                self._servers[sidx].send(("pull", subkey))
+                self._servers[sidx].send(("pull", subkey,
+                                          _xtrace.inject()))
                 issued.append((sidx, subkey, sl, True))
             except (OSError, EOFError, BrokenPipeError):
                 issued.append((sidx, subkey, sl, False))
@@ -410,6 +442,8 @@ class KVStoreDist(KVStoreLocal):
                     errors.append((sidx, reply[1]))
                 else:
                     out[sl] = reply[1]
+                    if ctx_out is not None and len(reply) > 2:
+                        ctx_out.append(reply[2])
             else:
                 retry.append((sidx, subkey, sl))
         if errors:
@@ -417,7 +451,8 @@ class KVStoreDist(KVStoreLocal):
                 "kvstore server %d: %s" % (s, e) for s, e in errors))
         for sidx, subkey, sl in retry:
             # dead server: _call reconnects via the scheduler and retries
-            out[sl] = self._call(sidx, ("pull", subkey))
+            out[sl] = self._call(sidx, ("pull", subkey, _xtrace.inject()),
+                                 ctx_out=ctx_out)
         return out.reshape(shape)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -442,15 +477,19 @@ class KVStoreDist(KVStoreLocal):
                         # defensively so no handle ever hangs.
                         while True:
                             try:
-                                handle, _ = self._pull_q.get_nowait()
+                                handle = self._pull_q.get_nowait()[0]
                             except queue.Empty:
                                 return
                             handle._finish(
                                 RuntimeError("kvstore is closed"))
-                    handle, args = task
+                    handle, args, ctx = task
                     t0 = time.perf_counter()
                     try:
-                        self.pull(*args)
+                        # The submitter's trace context rides the task:
+                        # the wire pull this thread performs belongs to
+                        # the step that asked for it, not the thread.
+                        with _xtrace.activate(ctx):
+                            self.pull(*args)
                     except BaseException as exc:   # noqa: BLE001 relayed
                         handle._finish(exc, time.perf_counter() - t0)
                         continue
@@ -479,7 +518,8 @@ class KVStoreDist(KVStoreLocal):
                 return handle
             self._ensure_pull_thread()
             self._pull_q.put((handle, (key, out, priority,
-                                       ignore_sparse)))
+                                       ignore_sparse),
+                              _xtrace.current()))
         return handle
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
@@ -497,7 +537,8 @@ class KVStoreDist(KVStoreLocal):
             for o, r in zip(olist, rlist * len(olist)
                             if len(rlist) == 1 else rlist):
                 r_np = r.asnumpy().astype(np.int64)
-                vals = np.asarray(self._call(sidx, ("pull_rows", subkey, r_np)))
+                vals = np.asarray(self._call(
+                    sidx, ("pull_rows", subkey, r_np, _xtrace.inject())))
                 if isinstance(o, _sparse.RowSparseNDArray):
                     from .ndarray.ndarray import array as _nd_array
 
@@ -528,7 +569,7 @@ class KVStoreDist(KVStoreLocal):
             finally:
                 optimizer.param_dict = param_dict
             for sidx in range(len(self._servers)):
-                self._call(sidx, ("set_optimizer", blob))
+                self._call(sidx, ("set_optimizer", blob, _xtrace.inject()))
         self._barrier()
 
     def server_profiler_command(self, sub, arg=None):
@@ -536,7 +577,7 @@ class KVStoreDist(KVStoreLocal):
         (reference KVStoreServerProfilerCommand,
         kvstore_dist_server.h:211-217). Returns the per-server replies
         — for ``"dumps"`` that is each server's aggregate span table."""
-        return [self._call(s, ("profiler", sub, arg))
+        return [self._call(s, ("profiler", sub, arg, _xtrace.inject()))
                 for s in range(len(self._servers))]
 
     # -- pod telemetry channel (telemetry.aggregate rides this) ---------------
@@ -549,12 +590,12 @@ class KVStoreDist(KVStoreLocal):
     def telemetry_push(self, blob):
         """Publish this rank's serialized telemetry snapshot
         (pipelined ack — rides the push fast path, no round-trip)."""
-        self._post(0, ("telemetry_push", self._rank, blob))
+        self._post(0, ("telemetry_push", self._rank, blob, _xtrace.inject()))
 
     def telemetry_pull(self):
         """Fetch every rank's last snapshot: ``{rank: (age_seconds,
         blob)}`` with ages measured on the server's clock."""
-        return self._call(0, ("telemetry_pull",))
+        return self._call(0, ("telemetry_pull", _xtrace.inject()))
 
     # -- pod forensics channel (telemetry.healthplane rides this) -------------
     # Flight-recorder bundles and pod-snapshot requests cross the same
@@ -566,24 +607,25 @@ class KVStoreDist(KVStoreLocal):
     def diag_push(self, name, blob):
         """Publish one committed diagnostic bundle (file name + bytes)
         for rank 0 to collect (pipelined ack, push fast path)."""
-        self._post(0, ("diag_push", self._rank, name, blob))
+        self._post(0, ("diag_push", self._rank, name, blob,
+                          _xtrace.inject()))
 
     def diag_pull(self):
         """Drain every rank's pushed bundles:
         ``{rank: [(name, blob), ...]}`` — each bundle hands off exactly
         once (rank 0's collector commits them to its directory)."""
-        return self._call(0, ("diag_pull",))
+        return self._call(0, ("diag_pull", _xtrace.inject()))
 
     def diag_request(self, kind, msg=""):
         """Post a pod-snapshot request (rank 0's fan-out trigger);
         returns the new request sequence number every rank's collector
         will observe."""
-        return self._call(0, ("diag_request", kind, msg))
+        return self._call(0, ("diag_request", kind, msg, _xtrace.inject()))
 
     def diag_request_check(self):
         """Read the current pod-snapshot request slot:
         ``(seq, kind, msg)`` (seq 0 = never requested)."""
-        return self._call(0, ("diag_request_check",))
+        return self._call(0, ("diag_request_check", _xtrace.inject()))
 
     # -- pod compile-cache channel (compile.distribute rides this) ------------
     # Persistent-compile-cache entries cross the same worker->server
@@ -596,15 +638,16 @@ class KVStoreDist(KVStoreLocal):
     def cc_push(self, key, meta, blob):
         """Publish one compile-cache entry (pipelined ack, push fast
         path)."""
-        self._post(0, ("cc_push", key, meta, blob))
+        self._post(0, ("cc_push", key, meta, blob,
+                          _xtrace.inject()))
 
     def cc_probe(self, keys):
         """Which of ``keys`` the pod rendezvous currently holds."""
-        return self._call(0, ("cc_probe", list(keys)))
+        return self._call(0, ("cc_probe", list(keys), _xtrace.inject()))
 
     def cc_pull(self, key):
         """Fetch one entry: ``(meta, blob)`` or None."""
-        return self._call(0, ("cc_pull", key))
+        return self._call(0, ("cc_pull", key, _xtrace.inject()))
 
     def set_gradient_compression(self, compression_params):
         from .gradient_compression import GradientCompression
@@ -616,7 +659,7 @@ class KVStoreDist(KVStoreLocal):
         """Gather per-server updater states (the optimizer state lives on
         the servers in dist mode — reference kvstore.py notes exactly
         this for update_on_kvstore)."""
-        blobs = [self._call(s, ("get_states",))
+        blobs = [self._call(s, ("get_states", _xtrace.inject()))
                  for s in range(len(self._servers))]
         # Durable artifact (resume loads it): commit atomically so a
         # crash mid-dump can't leave a torn pickle that unpickles as
@@ -630,7 +673,7 @@ class KVStoreDist(KVStoreLocal):
         if self._rank == 0:
             for sidx, blob in enumerate(blobs):
                 if blob:
-                    self._call(sidx, ("set_states", blob))
+                    self._call(sidx, ("set_states", blob, _xtrace.inject()))
         self._barrier()
 
     # -- coordination ---------------------------------------------------------

@@ -260,7 +260,8 @@ class Scheduler:
 # ---------------------------------------------------------------------------
 
 class _KeyState:
-    __slots__ = ("stored", "pending_pulls", "queues")
+    __slots__ = ("stored", "pending_pulls", "queues", "round_ctx",
+                 "applied_ctx")
 
     def __init__(self, value):
         self.stored = value                     # np.ndarray
@@ -270,6 +271,12 @@ class _KeyState:
         # the round closes (fire-and-forget sends) can never close a
         # round early or mix gradients across rounds.
         self.queues = {}                        # conn id -> [grad, ...]
+        # xtrace propagation: the OPEN round adopts the first push's
+        # wire trace context; once applied it becomes the value's
+        # context, echoed on pull replies so pullers can link their
+        # slice into the round's cross-rank flow.
+        self.round_ctx = None                   # wire ctx, open round
+        self.applied_ctx = None                 # wire ctx, last apply
 
     def in_open_round(self, conn_id):
         """True when this worker has a push not yet folded into an
@@ -320,6 +327,7 @@ class KVStoreServer:
         self._updater = None
         self._opt_blob = None       # pickled optimizer for snapshots
         self._sync_mode = True
+        self._trace_writer = None   # set by run() when MXNET_TRACE_DIR
         self._queue = queue.Queue()
         self.server_id = None
         # Snapshot-backed recovery (reference is_recovery for servers,
@@ -418,6 +426,10 @@ class KVStoreServer:
         self._updater(key, grad, stored)
         state.stored = stored.asnumpy()
 
+    # Index of the optional trailing wire trace context per push kind
+    # (workers inject it after the value payload; old peers omit it).
+    _PUSH_CTX_IDX = {"push": 3, "push_compressed": 4, "push_rsp": 4}
+
     def _grad_from_msg(self, msg, state):
         from .gradient_compression import GradientCompression
 
@@ -425,14 +437,25 @@ class KVStoreServer:
             return np.asarray(msg[2], dtype=np.float32)
         if msg[0] == "push_compressed":
             return GradientCompression.decompress(msg[2], msg[3])
-        # push_rsp: (cmd, key, indices, values) — scatter rows into a dense
-        # gradient of the stored shape (duplicates sum, like the
-        # reference's row_sparse merge on server).
-        _, _, indices, values = msg
+        # push_rsp: (cmd, key, indices, values[, ctx]) — scatter rows
+        # into a dense gradient of the stored shape (duplicates sum,
+        # like the reference's row_sparse merge on server).
+        indices, values = msg[2], msg[3]
         grad = np.zeros(state.stored.shape, dtype=np.float32)
         np.add.at(grad, np.asarray(indices, dtype=np.int64),
                   np.asarray(values, dtype=np.float32))
         return grad
+
+    def _traced_apply(self, key, state, grad_np, wire_ctx):
+        """Run :meth:`_apply` under the round's extracted trace context
+        so the server-side apply span joins the pushing step's flow."""
+        from .telemetry import trace as _ttrace
+        from .telemetry import xtrace as _xt
+
+        with _xt.activate(_xt.extract(wire_ctx)):
+            with _ttrace.span("kvstore::apply", key=str(key)):
+                self._apply(key, state, grad_np)
+        state.applied_ctx = wire_ctx
 
     @staticmethod
     def _send(conn, msg):
@@ -442,8 +465,11 @@ class KVStoreServer:
             pass
 
     def _answer_pull(self, conn, state, rows):
+        # The reply echoes the applied round's wire trace context — the
+        # puller stamps a FOREIGN context as link_trace_id, joining its
+        # slice into the pushing step's flow.
         value = state.stored if rows is None else state.stored[rows]
-        self._send(conn, ("val", value))
+        self._send(conn, ("val", value, state.applied_ctx))
 
     def _handle(self, conn, msg):
         """Execute one request — runs exclusively on the executor thread
@@ -483,11 +509,18 @@ class KVStoreServer:
                 self._send(conn, ("error", "key %r not initialized" % (key,)))
                 return
             grad = self._grad_from_msg(msg, state)
+            ctx_idx = self._PUSH_CTX_IDX[cmd]
+            wire_ctx = msg[ctx_idx] if len(msg) > ctx_idx else None
             if not self._sync_mode:
-                self._apply(key, state, grad)
+                self._traced_apply(key, state, grad, wire_ctx)
                 self._write_snapshot(key)
                 self._send(conn, ("ok",))
                 return
+            # The open round adopts the FIRST context-bearing push: one
+            # owner per round keeps the apply span (and the reply echo)
+            # a single flow instead of a fan-in of every worker's trace.
+            if wire_ctx is not None and state.round_ctx is None:
+                state.round_ctx = wire_ctx
             wid = self._conn_rank.get(id(conn), id(conn))
             state.queues.setdefault(wid, []).append(grad)
             # Round complete: one queued push from num_workers distinct
@@ -498,7 +531,8 @@ class KVStoreServer:
                 total = np.zeros(state.stored.shape, dtype=np.float32)
                 for q in ready:
                     total += q.pop(0)
-                self._apply(key, state, total)
+                self._traced_apply(key, state, total, state.round_ctx)
+                state.round_ctx = None
                 self._write_snapshot(key)
                 for (pconn, prows) in state.pending_pulls:
                     self._answer_pull(pconn, state, prows)
@@ -511,19 +545,33 @@ class KVStoreServer:
                 self._send(conn, ("error", "key %r not initialized" % (key,)))
                 return
             rows = np.asarray(msg[2]) if cmd == "pull_rows" else None
-            wid = self._conn_rank.get(id(conn), id(conn))
-            if self._sync_mode and state.in_open_round(wid):
-                # This worker contributed to the OPEN round, so it
-                # expects the value that includes its push: park until
-                # ApplyUpdates flushes it. A puller that has NOT pushed
-                # into the open round wants the last COMPLETED round —
-                # answer immediately (parking it would deadlock lockstep
-                # workers once pushes are pipelined: a fast worker's
-                # next-step push opens a round the slow worker can never
-                # help close while its own pull is parked).
-                state.pending_pulls.append((conn, rows))
-            else:
-                self._answer_pull(conn, state, rows)
+            # The serve side of a pull belongs to the REQUESTER's causal
+            # chain (a gateway request's backend pull, a trainer fetch):
+            # record it under the request's wire context so the flow
+            # reaches the server lane even when no apply ran for it.
+            ctx_idx = 3 if cmd == "pull_rows" else 2
+            req_ctx = msg[ctx_idx] if len(msg) > ctx_idx else None
+            from .telemetry import trace as _ttrace
+            from .telemetry import xtrace as _xt
+
+            with _xt.activate(_xt.extract(req_ctx)):
+                with _ttrace.span("kvstore::serve_pull",
+                                  key=str(msg[1])):
+                    wid = self._conn_rank.get(id(conn), id(conn))
+                    if self._sync_mode and state.in_open_round(wid):
+                        # This worker contributed to the OPEN round, so
+                        # it expects the value that includes its push:
+                        # park until ApplyUpdates flushes it. A puller
+                        # that has NOT pushed into the open round wants
+                        # the last COMPLETED round — answer immediately
+                        # (parking it would deadlock lockstep workers
+                        # once pushes are pipelined: a fast worker's
+                        # next-step push opens a round the slow worker
+                        # can never help close while its own pull is
+                        # parked).
+                        state.pending_pulls.append((conn, rows))
+                    else:
+                        self._answer_pull(conn, state, rows)
         elif cmd == "set_optimizer":
             from . import optimizer as opt
 
@@ -581,10 +629,10 @@ class KVStoreServer:
         elif cmd == "diag_request_check":
             self._send(conn, ("val", self._diag_request))
         elif cmd == "cc_push":
-            # Compile-cache rendezvous: (key, meta, blob). Replacing an
-            # existing key re-inserts it at the fresh end; the byte
-            # bound then retires oldest-first. Pipelined ack.
-            _, key, meta, blob = msg
+            # Compile-cache rendezvous: (key, meta, blob[, ctx]).
+            # Replacing an existing key re-inserts it at the fresh end;
+            # the byte bound then retires oldest-first. Pipelined ack.
+            key, meta, blob = msg[1], msg[2], msg[3]
             old = self._cc.pop(key, None)
             if old is not None:
                 self._cc_bytes -= len(old[1])
@@ -628,6 +676,15 @@ class KVStoreServer:
                 self._send(conn, ("ok",))
             elif sub == "dumps":
                 self._send(conn, ("val", _prof.dumps()))
+            elif sub == "trace_flush":
+                # Commit this server's pending trace segments NOW —
+                # rank 0 calls this right before trace_merge so the
+                # server lane is on disk deterministically instead of
+                # only at shutdown (segment age budget is 30s).
+                path = None
+                if self._trace_writer is not None:
+                    path = self._trace_writer.flush()
+                self._send(conn, ("val", path))
             else:
                 self._send(conn, ("error",
                                   "unknown profiler cmd %r" % (sub,)))
@@ -673,6 +730,20 @@ class KVStoreServer:
         threading.Thread(target=accept_loop, daemon=True).start()
         threading.Thread(target=self._reader, args=(sched,),
                          daemon=True).start()
+        # With MXNET_TRACE_DIR set, the server streams its own spans
+        # (kvstore::apply under the round's trace context) as segments
+        # in a lane past the worker ranks — the merged timeline then
+        # shows the server half of every push→apply→pull flow.
+        writer = None
+        trace_dir = os.environ.get("MXNET_TRACE_DIR")
+        if trace_dir:
+            from .telemetry.export import StreamingTraceWriter
+
+            writer = StreamingTraceWriter(
+                trace_dir, rank=self.num_workers + (self.server_id or 0))
+        # Exposed for the command channel's "trace_flush" (the handler
+        # runs on this same executor thread — no locking needed).
+        self._trace_writer = writer
         while True:
             conn, msg = self._queue.get()
             if msg[0] == "shutdown":
@@ -683,6 +754,10 @@ class KVStoreServer:
                 _dbg("handler error:", exc)
                 self._send(conn, ("error", "%s: %s" % (type(exc).__name__,
                                                        exc)))
+            if writer is not None:
+                writer.tick()
+        if writer is not None:
+            writer.close()
         listener.close()
 
 

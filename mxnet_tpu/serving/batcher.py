@@ -23,12 +23,13 @@ from concurrent.futures import Future
 
 from .admission import DeadlineExceededError
 from ..telemetry import trace as _trace
+from ..telemetry import xtrace as _xtrace
 
 __all__ = ["DynamicBatcher"]
 
 
 class _Request:
-    __slots__ = ("data", "rows", "future", "deadline", "t_submit")
+    __slots__ = ("data", "rows", "future", "deadline", "t_submit", "ctx")
 
     def __init__(self, data, rows, deadline, t_submit):
         self.data = data
@@ -36,6 +37,10 @@ class _Request:
         self.future = Future()
         self.deadline = deadline
         self.t_submit = t_submit
+        # Trace context: the submitter's when active, else a new root —
+        # the engine's queue_wait/device/request spans run under it.
+        ctx = _xtrace.current()
+        self.ctx = ctx if ctx is not None else _xtrace.new_root()
 
 
 class DynamicBatcher:
@@ -129,7 +134,8 @@ class DynamicBatcher:
             self._q.append(req)
             depth = len(self._q)
             self._cond.notify_all()
-        _trace.instant("serving::enqueue", rows=rows, depth=depth)
+        with _xtrace.activate(req.ctx):
+            _trace.instant("serving::enqueue", rows=rows, depth=depth)
         return req.future
 
     @property
@@ -188,6 +194,9 @@ class DynamicBatcher:
                         "request expired after %.1f ms in queue"
                         % ((now - req.t_submit) * 1e3)))
                 self._metrics.record_shed("deadline")
+                # Tail capture: mark the expired request's trace so the
+                # next flight-recorder bundle carries its span tree.
+                _xtrace.flag(req.ctx, "deadline_exceeded")
             else:
                 live.append(req)
         self._q = live

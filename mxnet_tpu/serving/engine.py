@@ -29,6 +29,7 @@ from ..ndarray.ndarray import NDArray
 from ..telemetry import healthplane as _hp
 from ..telemetry import trace as _trace
 from ..telemetry import watchdog as _watchdog
+from ..telemetry import xtrace as _xtrace
 from .admission import AdmissionController
 from .batcher import DynamicBatcher
 from .buckets import BucketPolicy
@@ -286,11 +287,15 @@ class InferenceServer:
         # retroactively so one Perfetto track shows queue wait vs device
         # time per request.
         for req in requests:
-            _trace.complete("serving::queue_wait", req.t_submit, t0,
-                            rows=req.rows, bucket=bucket)
+            with _xtrace.activate(req.ctx):
+                _trace.complete("serving::queue_wait", req.t_submit, t0,
+                                rows=req.rows, bucket=bucket)
         with self._model_lock:
-            with _trace.span("serving::device", bucket=bucket, rows=off,
-                             requests=len(requests)):
+            # One owner per batch: the device slice (and the model call)
+            # runs under the first request's trace context.
+            with _xtrace.activate(requests[0].ctx), \
+                    _trace.span("serving::device", bucket=bucket,
+                                rows=off, requests=len(requests)):
                 out = self._model(nd.array(batch, ctx=self._ctx))
                 outs = out if isinstance(out, tuple) else (out,)
                 for o in outs:
@@ -300,8 +305,9 @@ class InferenceServer:
         done = time.perf_counter()
         for req, i0, i1 in spans:
             sliced = tuple(o[i0:i1] for o in outs)
-            self.metrics.record_request_latency(bucket,
-                                                done - req.t_submit)
-            _trace.complete("serving::request", req.t_submit, done,
-                            rows=req.rows, bucket=bucket)
+            with _xtrace.activate(req.ctx):
+                self.metrics.record_request_latency(bucket,
+                                                    done - req.t_submit)
+                _trace.complete("serving::request", req.t_submit, done,
+                                rows=req.rows, bucket=bucket)
             req.future.set_result(sliced if len(sliced) > 1 else sliced[0])

@@ -57,6 +57,7 @@ from ..telemetry import healthplane as _hp
 from ..telemetry import metrics as _tm
 from ..telemetry import trace as _trace
 from ..telemetry import watchdog as _watchdog
+from ..telemetry import xtrace as _xtrace
 from ..telemetry.slo import BurnRateMonitor, ServiceLevelObjective
 from .admission import QueueFullError, ServiceUnavailableError, \
     DeadlineExceededError
@@ -116,7 +117,8 @@ class GatewayResult:
 
 
 class _GwRequest:
-    __slots__ = ("data", "rows", "future", "deadline", "t_submit", "cls")
+    __slots__ = ("data", "rows", "future", "deadline", "t_submit", "cls",
+                 "ctx")
 
     def __init__(self, data, rows, deadline, t_submit, cls):
         self.data = data
@@ -125,6 +127,12 @@ class _GwRequest:
         self.deadline = deadline
         self.t_submit = t_submit
         self.cls = cls
+        # The request's trace context: adopted from the submitter when
+        # one is active, else a fresh root — every span of this
+        # request's admission -> queue -> batch -> respond life (and
+        # any kvstore traffic the backend performs) carries it.
+        ctx = _xtrace.current()
+        self.ctx = ctx if ctx is not None else _xtrace.new_root()
 
 
 class _ModelState:
@@ -192,6 +200,7 @@ class ModelGateway:
             windows=burn_windows, alert_burn_rate=self._shed_burn,
             eval_interval_s=eval_interval_s, monitor=monitor, clock=clock)
         self._burn_lock = threading.Lock()
+        self._monitor = monitor
         self._ctx = ctx
         self._models = {}
         self._cond = threading.Condition()
@@ -461,8 +470,9 @@ class ModelGateway:
             self._cond.notify_all()
         _gw_requests.labels(model=model, deadline_class=cls).inc()
         _gw_queue.labels(model=model).set(depth)
-        _trace.instant("serving::gateway_enqueue", model=model, rows=rows,
-                       depth=depth)
+        with _xtrace.activate(req.ctx):
+            _trace.instant("serving::gateway_enqueue", model=model,
+                           rows=rows, depth=depth)
         return req.future
 
     def predict(self, model, data, deadline_class=None, timeout_ms=None):
@@ -660,6 +670,16 @@ class ModelGateway:
                             % ((now - req.t_submit) * 1e3)))
                     _gw_shed.labels(model=name, reason="deadline",
                                     deadline_class=req.cls).inc()
+                    # Tail capture: the expired request's trace is now
+                    # anomalous — the next flight-recorder bundle
+                    # carries its full span tree (peer ranks included).
+                    _xtrace.flag(req.ctx, "deadline_exceeded",
+                                 note="model=%s class=%s" % (name,
+                                                             req.cls))
+                    if self._monitor is not None:
+                        self._monitor.record_anomaly(
+                            "deadline_exceeded",
+                            "gateway %s: request expired in queue" % name)
                 else:
                     live.append(req)
                     self._total += 1
@@ -728,12 +748,18 @@ class ModelGateway:
                 spans.append((req, off, off + req.rows))
                 off += req.rows
             for req in requests:
-                _trace.complete("serving::gateway_queue_wait",
-                                req.t_submit, t0, model=name,
-                                rows=req.rows, bucket=bucket)
-            with _trace.span("serving::gateway_device", model=name,
-                             bucket=bucket, rows=off,
-                             generation=generation):
+                with _xtrace.activate(req.ctx):
+                    _trace.complete("serving::gateway_queue_wait",
+                                    req.t_submit, t0, model=name,
+                                    rows=req.rows, bucket=bucket)
+            # The device slice (and the backend call inside it) runs
+            # under the FIRST request's context: one owner per batch
+            # keeps the flow an arrow chain, and any kvstore traffic
+            # the backend performs injects that request's trace.
+            with _xtrace.activate(requests[0].ctx), \
+                    _trace.span("serving::gateway_device", model=name,
+                                bucket=bucket, rows=off,
+                                generation=generation):
                 out = backend(nd.array(batch,
                                        ctx=spec.ctx if spec.ctx is not None
                                        else self._ctx))
@@ -747,10 +773,11 @@ class ModelGateway:
             lat = _gw_latency.labels(model=name)
             for req, i0, i1 in spans:
                 sliced = tuple(o[i0:i1] for o in outs)
-                lat.observe(done - req.t_submit)
-                _trace.complete("serving::gateway_request", req.t_submit,
-                                done, model=name, rows=req.rows,
-                                bucket=bucket)
+                with _xtrace.activate(req.ctx):
+                    lat.observe(done - req.t_submit)
+                    _trace.complete("serving::gateway_request",
+                                    req.t_submit, done, model=name,
+                                    rows=req.rows, bucket=bucket)
                 req.future.set_result(GatewayResult(
                     sliced if len(sliced) > 1 else sliced[0],
                     name, generation))

@@ -116,6 +116,7 @@ wall-clock go" on a HEALTHY pod:
 from __future__ import annotations
 
 from . import metrics
+from . import xtrace
 from . import trace
 from . import aggregate
 from . import export
@@ -144,7 +145,8 @@ from .healthplane import HealthPlane, DiagCollector
 from .profiling import ContinuousProfiler
 from .attribution import StepAttribution
 
-__all__ = ["metrics", "trace", "aggregate", "export", "flamegraph",
+__all__ = ["metrics", "xtrace", "trace", "aggregate", "export",
+           "flamegraph",
            "slo", "memstats", "watchdog", "recorder", "numerics",
            "healthplane", "profiling", "attribution", "remote_write",
            "Registry", "REGISTRY", "counter", "gauge",

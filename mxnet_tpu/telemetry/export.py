@@ -18,8 +18,11 @@ Segment format (``trace.rank<R>.<SEQ>.jsonl``): one JSON object per
 line. The first line is a header ::
 
     {"meta": {"format": "mxnet_tpu.trace_segment/1", "pid": ..,
-              "rank": .., "seq": ..,
+              "rank": .., "seq": .., "dropped": ..,
               "wall_anchor_us": .., "perf_anchor_us": ..}}
+
+(``dropped`` counts spans lost to ring overflow since the previous
+segment — the merger annotates the gap instead of splicing silently)
 
 and every following line is a chrome trace event (``ph``/``name``/
 ``ts``/``pid``/``tid`` + ``dur`` for complete events), including
@@ -131,6 +134,7 @@ class StreamingTraceWriter:
         self._lines = []            # serialized, not-yet-committed lines
         self._bytes = 0
         self._oldest = None         # clock() when _lines went non-empty
+        self._dropped = 0           # ring-overflow drops pending a header
         self._named = set()         # tids already announced this segment
         self._closed = False
         self.committed = []         # segment paths this writer produced
@@ -173,6 +177,10 @@ class StreamingTraceWriter:
 
     def _drain_locked(self):
         drained = _trace.drain()
+        # Overflow accounting rides the same harvest: drops since the
+        # last drain belong to THIS segment's gap, so they land in its
+        # header (trace_merge renders the gap annotation from it).
+        self._dropped += _trace.take_dropped()
         if drained and self._oldest is None:
             self._oldest = self._clock()
         for thread_name, tid, events in drained:
@@ -189,7 +197,7 @@ class StreamingTraceWriter:
         header = json.dumps(
             {"meta": dict(self._anchor, format=SEGMENT_FORMAT,
                           pid=os.getpid(), rank=self.rank,
-                          seq=self._seq)},
+                          seq=self._seq, dropped=self._dropped)},
             separators=(",", ":"))
         data = "\n".join([header] + self._lines) + "\n"
         path = os.path.join(self.directory,
@@ -199,6 +207,7 @@ class StreamingTraceWriter:
         self._lines = []
         self._bytes = 0
         self._oldest = None
+        self._dropped = 0
         self._named = set()
         self.committed.append(path)
         return path

@@ -34,6 +34,7 @@ import time
 
 from . import metrics as _metrics
 from . import trace as _trace
+from . import xtrace as _xtrace
 from .. import log as _log
 
 __all__ = ["StepMonitor"]
@@ -259,6 +260,10 @@ class StepMonitor:
         self._anomalies.labels(kind=kind).inc()
         self._legacy.increment()
         _trace.instant("telemetry::anomaly", kind=kind)
+        # Tail capture: the detecting thread usually still holds the
+        # offending step's trace context — flag it so the flight
+        # recorder bundles that trace's full span tree.
+        _xtrace.flag_current(kind, note=msg)
         _log.warn_rate_limited(
             self._logger, "step_monitor:%d:%s" % (id(self), kind),
             self.warn_interval_s, "[telemetry:%s] %s", kind, msg,

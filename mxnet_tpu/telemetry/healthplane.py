@@ -36,6 +36,10 @@ module closes that loop with three pieces:
   ``GET /debug/attribution``   step-phase decomposition, bound cause and
                                per-site executable flops
   ``POST /debug/bundle``       trigger a local flight-recorder bundle NOW
+  ``POST /debug/xprof``        capture ``?seconds=N`` of device profile via
+                               ``jax.profiler.trace`` into a bundle-linked
+                               directory (501 + counted failure where the
+                               profiler backend is unavailable)
   ===========================  =============================================
 
   Everything is a JSON view over state the forensics layer already
@@ -60,6 +64,7 @@ import time
 
 from . import metrics as _metrics
 from . import watchdog as _watchdog
+from .. import env as _env
 from .. import log as _log
 
 __all__ = ["HealthPlane", "DiagCollector", "unique_component",
@@ -134,6 +139,12 @@ def reset():
 
 # -- the endpoint handler ------------------------------------------------------
 
+_xprof_failures = _metrics.REGISTRY.counter(
+    "mx_xprof_failures_total",
+    "POST /debug/xprof captures that failed (profiler backend "
+    "unavailable, or the trace itself errored)")
+
+
 class HealthPlane:
     """JSON views over the forensics layer, mountable on a
     :class:`~.metrics.MetricsServer` via
@@ -154,16 +165,22 @@ class HealthPlane:
         (default: the process's active profiler; 404 when none runs).
     attribution : StepAttribution, optional — backs
         ``/debug/attribution`` (404 without one).
+    xprof_dir : capture root for ``POST /debug/xprof`` (default: the
+        ``MXNET_XPROF_DIR`` knob, else ``<recorder.directory>/xprof``
+        so captures land next to the bundles that reference them).
     """
 
     def __init__(self, watchdog=None, recorder=None, pipelines=(),
-                 profiler=None, attribution=None):
+                 profiler=None, attribution=None, xprof_dir=None):
         self._watchdog = watchdog if watchdog is not None \
             else _watchdog.HangWatchdog()
         self._recorder = recorder
         self._pipelines = list(pipelines)
         self._profiler = profiler
         self._attribution = attribution
+        self._xprof_dir = xprof_dir
+        self._xprof_lock = threading.Lock()
+        self._xprof_seq = 0
 
     def watch_pipeline(self, pipeline):
         """Include a pipeline's ``debug_state()`` in ``/debug/pipeline``
@@ -252,6 +269,49 @@ class HealthPlane:
             return 404, {"error": "no StepAttribution attached"}
         return 200, self._attribution.snapshot()
 
+    def xprof(self, seconds=1.0):
+        """``POST /debug/xprof`` body: capture ``seconds`` of device
+        profile via ``jax.profiler.trace`` into a fresh subdirectory
+        of the capture root. Returns ``(status, body)`` — 200 with the
+        capture directory, 404 when no root is resolvable, 409 while
+        another capture runs, 501 (counted on
+        ``mx_xprof_failures_total``) where the profiler backend is
+        unavailable or the trace errors — a CPU-only jaxlib must
+        degrade, not crash the health plane."""
+        try:
+            seconds = float(seconds)
+        except (TypeError, ValueError):
+            return 400, {"error": "seconds must be a number"}
+        seconds = min(60.0, max(0.05, seconds))
+        base = self._xprof_dir
+        if base is None:
+            base = _env.get("MXNET_XPROF_DIR", "") or None
+        if base is None and self._recorder is not None:
+            base = os.path.join(self._recorder.directory, "xprof")
+        if base is None:
+            return 404, {"error": "no capture directory (pass "
+                                  "xprof_dir=, set MXNET_XPROF_DIR, or "
+                                  "attach a FlightRecorder)"}
+        if not self._xprof_lock.acquire(blocking=False):
+            return 409, {"error": "an xprof capture is already running"}
+        try:
+            self._xprof_seq += 1
+            out_dir = os.path.join(base,
+                                   "xprof.%06d" % self._xprof_seq)
+            try:
+                import jax
+
+                os.makedirs(out_dir, exist_ok=True)
+                with jax.profiler.trace(out_dir):
+                    time.sleep(seconds)
+            except Exception as exc:
+                _xprof_failures.inc()
+                return 501, {"error": "profiler backend unavailable: "
+                                      "%r" % exc}
+            return 200, {"dir": out_dir, "seconds": seconds}
+        finally:
+            self._xprof_lock.release()
+
     # -- HTTP routing (used by metrics.start_http_server) ---------------------
 
     def handle(self, method, path):
@@ -292,13 +352,19 @@ class HealthPlane:
                 return self.pprof(seconds=seconds, format=fmt)
             if path == "/debug/attribution":
                 return self.attribution_state()
-        elif method == "POST" and path == "/debug/bundle":
-            if self._recorder is None:
-                return 404, {"error": "no FlightRecorder attached"}
-            bundle = self.trigger_bundle()
-            if bundle is None:
-                return 503, {"error": "bundle commit failed (see logs)"}
-            return 200, {"bundle": bundle}
+        elif method == "POST":
+            if path == "/debug/bundle":
+                if self._recorder is None:
+                    return 404, {"error": "no FlightRecorder attached"}
+                bundle = self.trigger_bundle()
+                if bundle is None:
+                    return 503, {"error":
+                                 "bundle commit failed (see logs)"}
+                return 200, {"bundle": bundle}
+            if path == "/debug/xprof":
+                params = parse_qs(query)
+                seconds = params.get("seconds", ["1.0"])[0]
+                return self.xprof(seconds)
         return None
 
 
@@ -392,6 +458,8 @@ class DiagCollector:
         self._handled_seq = seq
         if kind == "pod_profile":
             return self._push_profile(seq, msg)
+        if kind == "pod_trace":
+            return self._push_trace(seq, msg)
         return self._recorder.request(kind or "pod_snapshot", msg or "")
 
     def _push_profile(self, seq, msg):
@@ -409,6 +477,28 @@ class DiagCollector:
             profiler.collapsed(seconds=seconds), "rank%d" % self.rank)
         name = "profile.rank%d.%06d.collapsed" % (self.rank, seq)
         self._kv.diag_push(name, capture.encode("utf-8"))
+        return name
+
+    def _push_trace(self, seq, msg):
+        """Answer a ``pod_trace`` fan-out: push this rank's buffered
+        spans for the requested trace id
+        (``xtrace.rank<R>.<seq>.json``). An empty span list is still
+        pushed — rank 0's :meth:`collect_trace` can then tell "rank
+        answered, trace never touched it" from "rank has not answered
+        yet"."""
+        import json
+
+        from . import xtrace as _xtrace
+
+        trace_id = (msg or "").strip()
+        if not trace_id:
+            return None
+        blob = json.dumps(
+            {"trace_id": trace_id, "rank": self.rank,
+             "spans": _xtrace.collect_spans(trace_id)},
+            default=str).encode("utf-8")
+        name = "xtrace.rank%d.%06d.json" % (self.rank, seq)
+        self._kv.diag_push(name, blob)
         return name
 
     def push_new(self):
@@ -473,10 +563,10 @@ class DiagCollector:
             return []
         for rd in rank_dirs:
             rank_dir = os.path.join(self.directory, rd)
-            # keep_last applies PER KIND (diag bundles vs profile
-            # captures) so a burst of profile pulls cannot evict the
-            # incident's diag bundles, and vice versa.
-            for prefix in ("diag.", "profile."):
+            # keep_last applies PER KIND (diag bundles vs profile vs
+            # trace captures) so a burst of profile pulls cannot evict
+            # the incident's diag bundles, and vice versa.
+            for prefix in ("diag.", "profile.", "xtrace."):
                 try:
                     names = sorted(n for n in os.listdir(rank_dir)
                                    if n.startswith(prefix))
@@ -536,6 +626,101 @@ class DiagCollector:
         request sequence number."""
         msg = "" if seconds is None else repr(float(seconds))
         return self._kv.diag_request("pod_profile", msg)
+
+    def request_pod_trace(self, trace_id):
+        """Fan out a trace-span capture to EVERY rank: each rank's next
+        ``tick()`` pushes its locally buffered spans for ``trace_id``
+        (tail-based capture's cross-process leg). Returns the request
+        sequence number."""
+        return self._kv.diag_request("pod_trace", str(trace_id))
+
+    def collect_trace(self, trace_id, timeout_s=10.0, poll_s=0.05):
+        """Rank 0: fan a ``pod_trace`` request out and assemble the
+        trace's full cross-process span tree from the per-rank
+        replies. Drives this collector's own duties while waiting
+        (peer ranks answer on their own tick cadence), returning after
+        every known rank answered or ``timeout_s`` — partial trees are
+        still forensics. Returns ``{"trace_id", "ranks", "spans"}``
+        with each span dict carrying its source ``rank``."""
+        if self.rank != 0:
+            raise ValueError("collect_trace runs on rank 0")
+        self.request_pod_trace(trace_id)
+        expected = getattr(self._kv, "num_workers", None)
+        deadline = self._clock() + float(timeout_s)
+        found = {}
+        while True:
+            try:
+                self.step()
+            except Exception:
+                pass
+            for rank, spans in self._scan_traces(trace_id).items():
+                found[rank] = spans
+            if expected is not None and len(found) >= expected:
+                break
+            if self._clock() >= deadline:
+                break
+            time.sleep(poll_s)
+        spans = []
+        for rank in sorted(found):
+            for event in found[rank]:
+                spans.append(dict(event, rank=rank))
+        spans.sort(key=lambda e: e.get("ts", 0))
+        return {"trace_id": trace_id, "ranks": sorted(found),
+                "spans": spans}
+
+    def _scan_traces(self, trace_id):
+        """Collected ``xtrace.rank<R>.*.json`` replies for
+        ``trace_id``, as ``{rank: spans}`` (rank 0; newest reply per
+        rank wins)."""
+        import json
+
+        out = {}
+        if self.rank != 0 or self.directory is None:
+            return out
+        try:
+            rank_dirs = sorted(os.listdir(self.directory))
+        except OSError:
+            return out
+        for rd in rank_dirs:
+            rank_dir = os.path.join(self.directory, rd)
+            if not os.path.isdir(rank_dir):
+                continue
+            try:
+                names = sorted(n for n in os.listdir(rank_dir)
+                               if n.startswith("xtrace."))
+            except OSError:
+                continue
+            for name in names:
+                try:
+                    with open(os.path.join(rank_dir, name)) as f:
+                        reply = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if reply.get("trace_id") != trace_id:
+                    continue
+                out[int(reply.get("rank", 0))] = \
+                    reply.get("spans") or []
+        return out
+
+    def feed_recorder(self, recorder):
+        """Wire collected peer-rank spans into a FlightRecorder's
+        bundles: registers an ``xtrace_peers`` extra source that, at
+        capture time, resolves every flagged trace against the replies
+        this collector has already pulled — a bundle captured after
+        :meth:`collect_trace` carries the full cross-process span tree
+        of the offending request. Returns the recorder."""
+        recorder.add_source("xtrace_peers", self._peer_traces)
+        return recorder
+
+    def _peer_traces(self):
+        from . import xtrace as _xtrace
+
+        out = {}
+        for entry in _xtrace.flagged():
+            tid = entry["trace_id"]
+            if tid not in out:
+                out[tid] = self._scan_traces(tid)
+        return out
 
     def merged_pod_profile(self):
         """Rank 0: merge every collected ``profile.*.collapsed`` into

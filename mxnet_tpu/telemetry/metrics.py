@@ -110,19 +110,36 @@ def default_buckets(start=1e-4, factor=2.0, count=21):
 # -- children (one labeled time series each) ----------------------------------
 
 class _CounterChild:
-    __slots__ = ("_lock", "_value")
+    __slots__ = ("_lock", "_value", "_ex")
 
     def __init__(self):
         self._lock = threading.Lock()
         self._value = 0
+        self._ex = None          # (span_id, delta, wall_ts)
 
     def inc(self, delta=1):
         if delta < 0:
             raise ValueError("counters are monotonic; inc by %r" % (delta,))
         if not _enabled[0]:
             return
+        ex = None
+        if _exemplars[0]:
+            src = _span_source[0]
+            sid = src() if src is not None else None
+            if sid is not None:
+                ex = (sid, delta, time.time())
         with self._lock:
             self._value += delta
+            if ex is not None:
+                self._ex = ex
+
+    @property
+    def exemplar(self):
+        """Latest (span_id, delta, wall_ts) recorded inside a span, or
+        None (``inc_try`` never records one — it must stay
+        non-blocking)."""
+        with self._lock:
+            return self._ex
 
     def inc_try(self, delta=1):
         """Non-blocking inc for signal-handler/lock-sensitive contexts
@@ -353,6 +370,10 @@ class CounterFamily(_Family):
     def value(self):
         return self._sole().value
 
+    @property
+    def exemplar(self):
+        return self._sole().exemplar
+
 
 class GaugeFamily(_Family):
     kind = "gauge"
@@ -495,8 +516,15 @@ class Registry:
                     out.append("%s_count%s %d" % (fam.name, base,
                                                   snap["count"]))
                 else:
-                    out.append("%s%s %s" % (fam.name, base,
-                                            _fmt(child.value)))
+                    line = "%s%s %s" % (fam.name, base,
+                                        _fmt(child.value))
+                    if openmetrics and fam.kind == "counter":
+                        ex = child.exemplar
+                        if ex is not None:
+                            line += ' # {span_id="%s"} %s %s' % (
+                                _esc_label(str(ex[0])), _fmt(ex[1]),
+                                _fmt(ex[2]))
+                    out.append(line)
         if openmetrics:
             out.append("# EOF")
         return "\n".join(out) + "\n"
@@ -556,11 +584,22 @@ def render_prometheus(registry=None, openmetrics=False):
 def collect_exemplars(registry=None):
     """All recorded exemplars as a plain JSON-able list (the flight
     recorder's bundle view): ``[{metric, labels, le, span_id, value,
-    ts}]``. Empty when exemplars are disabled or nothing observed inside
-    a span yet."""
+    ts}]`` for histogram buckets, the same minus ``le`` for counters.
+    Empty when exemplars are disabled or nothing observed inside a span
+    yet."""
     reg = registry or REGISTRY
     out = []
     for fam in reg.collect():
+        if fam.kind == "counter":
+            for values, child in fam.collect():
+                ex = child.exemplar
+                if ex is None:
+                    continue
+                out.append({
+                    "metric": fam.name,
+                    "labels": dict(zip(fam.labelnames, values)),
+                    "span_id": ex[0], "value": ex[1], "ts": ex[2]})
+            continue
         if fam.kind != "histogram":
             continue
         for values, child in fam.collect():

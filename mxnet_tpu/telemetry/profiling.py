@@ -61,6 +61,7 @@ from collections import deque
 from . import flamegraph as _flamegraph
 from . import metrics as _metrics
 from . import watchdog as _watchdog
+from . import xtrace as _xtrace
 
 __all__ = ["ContinuousProfiler", "ProfileWindow", "active_profiler",
            "bundle_state", "merge_collapsed", "prefix_collapsed"]
@@ -280,6 +281,12 @@ class ContinuousProfiler:
             if tid == own:
                 continue
             parts = []
+            # A thread holding an active sampled TraceContext gets a
+            # ``trace:<id>`` LEAF frame: a hot frame in /debug/pprof
+            # then links to concrete traces in the merged timeline.
+            ctx = _xtrace.context_of_thread(tid)
+            if ctx is not None and ctx.sampled:
+                parts.append("trace:%s" % ctx.trace_id)
             while frame is not None:
                 code = frame.f_code
                 parts.append(_flamegraph.frame_label(

@@ -26,6 +26,12 @@ Bundle contents (the black-box recorder set):
   (:func:`..aggregate.snapshot_registry`) plus any recorded exemplars;
 * ``anomalies`` — recent anomaly history (what fired, when) and every
   attached monitor's counters/EWMA/step count;
+* ``xtrace`` — tail-based trace capture: every trace flagged anomalous
+  (:func:`~mxnet_tpu.telemetry.xtrace.flag` — deadline-exceeded
+  requests, slow steps, SLO burn) with its full locally-buffered span
+  tree, so the offending request/step reconstructs from the bundle
+  alone (peer-rank spans ride in via
+  :meth:`~mxnet_tpu.telemetry.healthplane.DiagCollector.feed_recorder`);
 * ``data`` — each watched pipeline's delivered-batch watermark and the
   ids of the batch in flight (``DataPipeline.debug_state``), so a
   poison batch is replayable;
@@ -303,6 +309,7 @@ class FlightRecorder:
             },
             "data": [self._safe("pipeline", self._pipeline_state(p))
                      for p in self._pipelines],
+            "xtrace": self._safe("xtrace", self._xtrace_state),
             "watchdog": self._safe("watchdog", self._watchdog_state),
             "profile": self._safe("profile", self._profile_state),
             "device_memory": self._safe("device_memory",
@@ -314,6 +321,24 @@ class FlightRecorder:
             bundle["extra"] = {name: self._safe(name, fn)
                                for name, fn in self._extra.items()}
         return bundle
+
+    def _xtrace_state(self):
+        """Tail-based capture: the span tree of every trace flagged
+        anomalous (deadline-exceeded, slow_step, SLO burn) —
+        ``flagged`` entries plus each trace's locally buffered spans,
+        and whatever peer-rank spans a DiagCollector has already
+        collected for it (``feed_recorder`` wires that in via
+        ``extra``; peers answer asynchronously over the diag
+        channel)."""
+        from . import xtrace as _xtrace
+
+        flags = _xtrace.flagged()
+        spans = {}
+        for entry in flags:
+            tid = entry["trace_id"]
+            if tid not in spans:
+                spans[tid] = _xtrace.collect_spans(tid)
+        return {"flagged": flags, "spans": spans}
 
     def _span_tail(self):
         """Last-N buffered trace events, oldest first — snapshotted

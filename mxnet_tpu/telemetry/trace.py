@@ -44,10 +44,12 @@ import threading
 import time
 from collections import deque
 
+from . import xtrace as _xtrace
+
 __all__ = ["span", "instant", "complete", "chrome_trace", "dump",
            "drain", "clear", "set_enabled", "enabled", "set_capacity",
            "capacity", "event_count", "set_span_ids", "span_ids_enabled",
-           "current_span_id"]
+           "current_span_id", "take_dropped"]
 
 _DEFAULT_CAPACITY = 16384
 # Rings of dead threads retained for the next flush (most recent first
@@ -59,8 +61,11 @@ _MAX_DEAD_RINGS = 32
 _state = {"enabled": True, "capacity": _DEFAULT_CAPACITY,
           "span_ids": False}
 _registry_lock = threading.Lock()
-_rings = []            # [(thread, deque), ...]
+_rings = []            # [(thread, deque, drops-cell), ...]
 _tls = threading.local()
+# mx_trace_dropped_spans_total{thread} — created lazily on the first
+# drop (trace<->metrics import late-binds through the package).
+_dropped_fam = None
 # Process-unique span ids (itertools.count.__next__ is atomic under the
 # GIL, so no lock on the span hot path).
 _span_counter = itertools.count(1)
@@ -125,18 +130,63 @@ def _ring():
     if ring is None:
         thread = threading.current_thread()
         ring = deque(maxlen=_state["capacity"])
+        drops = [0]
         with _registry_lock:
             _prune_locked()
-            _rings.append((thread, ring))
+            _rings.append((thread, ring, drops))
         _tls.ring = ring
+        _tls.drops = drops
     return ring
+
+
+def _append(record):
+    """Ring append with overflow accounting: a full bounded deque drops
+    its oldest on append — count that (per-ring cell for the streaming
+    segment headers, ``mx_trace_dropped_spans_total{thread}`` for the
+    scrape) instead of losing spans silently."""
+    ring = _ring()
+    if len(ring) == ring.maxlen:
+        _tls.drops[0] += 1
+        global _dropped_fam
+        if _dropped_fam is None:
+            from . import metrics as _metrics
+
+            _dropped_fam = _metrics.REGISTRY.counter(
+                "mx_trace_dropped_spans_total",
+                "spans dropped by per-thread ring overflow",
+                labels=("thread",))
+        _dropped_fam.labels(
+            thread=threading.current_thread().name).inc()
+    ring.append(record)
+
+
+def take_dropped():
+    """Total spans dropped by ring overflow since the last call (the
+    streaming exporter stamps this into each segment header as
+    ``dropped`` so trace_merge can annotate the gap). Best-effort
+    under concurrency: a drop racing the harvest lands in the next
+    harvest."""
+    with _registry_lock:
+        entries = list(_rings)
+    total = 0
+    for _, _, drops in entries:
+        n = drops[0]
+        if n:
+            drops[0] -= n
+            total += n
+    return total
 
 
 class _Span:
     """Context manager recording one complete event on exit. Cheap when
-    tracing is disabled: no clock read, no ring append."""
+    tracing is disabled: no clock read, no ring append. Under an active
+    sampled :mod:`xtrace` context the span allocates an id, records
+    ``trace_id``/``parent_span_id`` linkage, and installs itself as the
+    parent of anything the block opens (including across process seams
+    via ``xtrace.inject``)."""
 
-    __slots__ = ("_name", "_args", "_t0", "_id")
+    __slots__ = ("_name", "_args", "_t0", "_id", "_link", "_token",
+                 "_pushed")
 
     def __init__(self, name, args):
         self._name = name
@@ -144,14 +194,24 @@ class _Span:
 
     def __enter__(self):
         self._id = None
+        self._link = None
+        self._token = None
+        self._pushed = False
         if _state["enabled"]:
-            if _state["span_ids"]:
+            ctx = _xtrace.current()
+            traced = ctx is not None and ctx.sampled
+            if traced or _state["span_ids"]:
                 sid = "%x" % next(_span_counter)
-                stack = getattr(_tls, "span_ids", None)
-                if stack is None:
-                    stack = _tls.span_ids = []
-                stack.append(sid)
                 self._id = sid
+                if _state["span_ids"]:
+                    stack = getattr(_tls, "span_ids", None)
+                    if stack is None:
+                        stack = _tls.span_ids = []
+                    stack.append(sid)
+                    self._pushed = True
+                if traced:
+                    self._link = (ctx.trace_id, ctx.span_id)
+                    self._token = _xtrace._push_child(ctx, sid)
             self._t0 = time.perf_counter()
         else:
             self._t0 = None
@@ -159,7 +219,9 @@ class _Span:
 
     def __exit__(self, *exc):
         t0 = self._t0
-        if self._id is not None:
+        if self._token is not None:
+            _xtrace._pop(self._token)
+        if self._pushed:
             # Spans are context-managed, so the per-thread id stack is
             # strictly LIFO.
             stack = getattr(_tls, "span_ids", None)
@@ -171,8 +233,10 @@ class _Span:
             if self._id is not None:
                 args = dict(args) if args else {}
                 args["span_id"] = self._id
-            _ring().append(("X", self._name, t0 * 1e6, (t1 - t0) * 1e6,
-                            args))
+                if self._link is not None:
+                    args["trace_id"], args["parent_span_id"] = self._link
+            _append(("X", self._name, t0 * 1e6, (t1 - t0) * 1e6,
+                     args))
         return False
 
 
@@ -182,25 +246,36 @@ def span(name, **args):
     return _Span(name, args or None)
 
 
+def _stamp(args):
+    """Mark an event with the active sampled trace context (explicit
+    caller-passed ids win — the serving worker stamps a REQUEST's
+    context onto retroactive events recorded outside its activation)."""
+    ctx = _xtrace.current()
+    if ctx is not None and ctx.sampled:
+        args.setdefault("trace_id", ctx.trace_id)
+        args.setdefault("parent_span_id", ctx.span_id)
+    return args
+
+
 def instant(name, **args):
     """Zero-duration marker event."""
     if _state["enabled"]:
-        _ring().append(("i", name, time.perf_counter() * 1e6, 0,
-                        args or None))
+        _append(("i", name, time.perf_counter() * 1e6, 0,
+                 _stamp(args) or None))
 
 
 def complete(name, start_s, end_s, **args):
     """Retroactive span from explicit ``time.perf_counter()`` seconds —
     lets a worker emit e.g. a request's queue-wait after the fact."""
     if _state["enabled"]:
-        _ring().append(("X", name, start_s * 1e6,
-                        max(0.0, end_s - start_s) * 1e6, args or None))
+        _append(("X", name, start_s * 1e6,
+                 max(0.0, end_s - start_s) * 1e6, _stamp(args) or None))
 
 
 def event_count():
     """Total buffered events across every thread ring."""
     with _registry_lock:
-        rings = [r for _, r in _rings]
+        rings = [entry[1] for entry in _rings]
     return sum(len(r) for r in rings)
 
 
@@ -209,7 +284,7 @@ def clear():
     threads' rings are released)."""
     with _registry_lock:
         _rings[:] = [entry for entry in _rings if entry[0].is_alive()]
-        rings = [r for _, r in _rings]
+        rings = [entry[1] for entry in _rings]
     for r in rings:
         r.clear()
 
@@ -236,7 +311,7 @@ def chrome_trace():
     events = []
     with _registry_lock:
         rings = list(_rings)
-    for thread, ring in rings:
+    for thread, ring, _drops in rings:
         tid = thread.ident or 0
         events.append({"ph": "M", "name": "thread_name", "pid": pid,
                        "tid": tid, "ts": 0, "args": {"name": thread.name}})
@@ -266,7 +341,7 @@ def drain(prune_dead=True):
     with _registry_lock:
         rings = list(_rings)
     out = []
-    for thread, ring in rings:
+    for thread, ring, _drops in rings:
         events = []
         while True:
             try:
@@ -276,9 +351,13 @@ def drain(prune_dead=True):
         if events:
             out.append((thread.name, thread.ident or 0, events))
     if prune_dead:
+        # A dead ring with an unharvested drop count stays registered
+        # until take_dropped() collects it — otherwise the drops of a
+        # short-lived thread would vanish with its ring.
         with _registry_lock:
             _rings[:] = [entry for entry in _rings
-                         if entry[0].is_alive() or len(entry[1])]
+                         if entry[0].is_alive() or len(entry[1])
+                         or entry[2][0]]
     return out
 
 

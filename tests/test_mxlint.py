@@ -588,6 +588,84 @@ class TestTelemetryNaming:
 
 
 # ---------------------------------------------------------------------------
+# trace-propagation
+# ---------------------------------------------------------------------------
+
+class TestTracePropagation:
+    def test_payload_without_ctx_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            class KV:
+                def push(self, key, value):
+                    self._post(0, ("push", key, value))
+            """, checks=["trace-propagation"])
+        assert checks_of(res) == ["trace-propagation"]
+        assert "push" in res.findings[0].message
+
+    def test_inject_call_quiet(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.telemetry import xtrace as _xtrace
+            class KV:
+                def push(self, key, value):
+                    self._post(0, ("push", key, value, _xtrace.inject()))
+                def pull(self, key):
+                    return self._call(0, ("pull", key, _xtrace.inject()))
+            """, checks=["trace-propagation"])
+        assert res.findings == []
+
+    def test_forwarded_ctx_name_quiet(self, tmp_path):
+        # Re-sending an already-extracted wire context (the server's
+        # pull-reply echo shape) counts as carrying one.
+        res = lint(tmp_path, """
+            class KV:
+                def forward(self, key, value, wire_ctx):
+                    self._post(0, ("push_rsp", key, value, wire_ctx))
+                def echo(self, state):
+                    self._post(0, ("val", state.value, state.applied_ctx))
+            """, checks=["trace-propagation"])
+        assert res.findings == []
+
+    def test_call_without_ctx_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            class KV:
+                def pull(self, key):
+                    return self._call(0, ("pull", key))
+            """, checks=["trace-propagation"])
+        assert checks_of(res) == ["trace-propagation"]
+
+    def test_opaque_payload_quiet(self, tmp_path):
+        # A payload built elsewhere and passed by name is opaque — the
+        # build site is where the tuple literal (and a finding) lives.
+        res = lint(tmp_path, """
+            class KV:
+                def send(self, msg):
+                    self._post(0, msg)
+                def splice(self, head, rest):
+                    self._post(0, ("cmd", *rest))
+            """, checks=["trace-propagation"])
+        assert res.findings == []
+
+    def test_non_command_tuple_quiet(self, tmp_path):
+        # Only command tuples (string head) are framing; a bare data
+        # tuple is not a payload this rule owns.
+        res = lint(tmp_path, """
+            class KV:
+                def send(self, a, b):
+                    self._post(0, (a, b))
+            """, checks=["trace-propagation"])
+        assert res.findings == []
+
+    def test_justified_suppression_honored(self, tmp_path):
+        res = lint(tmp_path, """
+            class KV:
+                def ping(self):
+                    # mxlint: disable=trace-propagation -- liveness
+                    # probe, never part of a causal chain
+                    self._post(0, ("ping",))
+            """, checks=["trace-propagation"])
+        assert res.findings == [] and res.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 

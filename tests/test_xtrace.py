@@ -1,0 +1,370 @@
+"""End-to-end causal tracing (ISSUE 18 tentpole): TraceContext
+propagation + head sampling, span linkage, wire inject/extract,
+tail-based capture into FlightRecorder bundles (local and cross-rank
+over the diag channel), trace-anchored exemplars, profiler trace
+tagging, and the POST /debug/xprof endpoint."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (backend init before telemetry)
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import aggregate
+from mxnet_tpu.telemetry import healthplane as hp
+from mxnet_tpu.telemetry import metrics as tmetrics
+from mxnet_tpu.telemetry import trace, xtrace
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    trace.clear()
+    xtrace.clear_flags()
+    yield
+    trace.clear()
+    xtrace.clear_flags()
+
+
+def _spans_by_name():
+    return {e["name"]: e for e in trace.chrome_trace()["traceEvents"]
+            if e.get("ph") == "X"}
+
+
+# -- context + linkage --------------------------------------------------------
+
+def test_spans_under_context_record_parent_child_linkage():
+    with xtrace.start() as ctx:
+        assert ctx.sampled            # default head rate is 1.0
+        with trace.span("xt::parent"):
+            with trace.span("xt::child"):
+                pass
+    spans = _spans_by_name()
+    parent, child = spans["xt::parent"], spans["xt::child"]
+    assert parent["args"]["trace_id"] == ctx.trace_id
+    assert child["args"]["trace_id"] == ctx.trace_id
+    # the root position parents the outer span; the outer span's fresh
+    # id parents the inner one
+    assert parent["args"]["parent_span_id"] == ctx.span_id
+    assert child["args"]["parent_span_id"] == parent["args"]["span_id"]
+    assert parent["args"]["span_id"] != child["args"]["span_id"]
+
+
+def test_spans_outside_any_context_stay_unstamped():
+    with trace.span("xt::plain"):
+        pass
+    assert "trace_id" not in (_spans_by_name()["xt::plain"]
+                              .get("args") or {})
+
+
+def test_inject_extract_roundtrip_and_junk_tolerance():
+    ctx = xtrace.new_root(sampled=True)
+    back = xtrace.extract(xtrace.inject(ctx))
+    assert (back.trace_id, back.span_id, back.sampled) == \
+        (ctx.trace_id, ctx.span_id, True)
+    # no active context -> no wire payload
+    assert xtrace.inject() is None
+    with xtrace.activate(ctx):
+        wire = xtrace.inject()
+        assert wire is not None and xtrace.extract(wire).trace_id \
+            == ctx.trace_id
+    # a malformed peer must never break the receiver
+    for junk in (None, 42, "x", ("x",), (99, "a", "b", True),
+                 (1, 7, "s", True), [1, "a", "b", True]):
+        assert xtrace.extract(junk) is None
+
+
+def test_activation_masks_and_restores_even_across_threads_table():
+    me = threading.get_ident()
+    ctx = xtrace.new_root(sampled=True)
+    with xtrace.activate(ctx):
+        assert xtrace.current() is ctx
+        assert xtrace.context_of_thread(me).trace_id == ctx.trace_id
+        with xtrace.activate(None):   # mask (worker-thread isolation)
+            assert xtrace.current() is None
+            assert xtrace.context_of_thread(me) is None
+        assert xtrace.current() is ctx
+    assert xtrace.current() is None
+    assert xtrace.context_of_thread(me) is None
+
+
+# -- head sampling ------------------------------------------------------------
+
+def test_sample_rate_zero_roots_unsampled_and_skips_stamping():
+    prev = xtrace.set_sample_rate(0.0)
+    try:
+        assert xtrace.new_root().sampled is False
+        with xtrace.start():
+            with trace.span("xt::unsampled"):
+                pass
+        assert "trace_id" not in (_spans_by_name()["xt::unsampled"]
+                                  .get("args") or {})
+        xtrace.set_sample_rate(1.0)
+        assert xtrace.new_root().sampled is True
+        # an explicit decision overrides the coin
+        xtrace.set_sample_rate(0.0)
+        assert xtrace.new_root(sampled=True).sampled is True
+    finally:
+        xtrace.set_sample_rate(prev)
+
+
+def test_sample_rate_env_knob_clamped_and_junk_tolerant(monkeypatch):
+    prev = xtrace.set_sample_rate(None)   # re-read env on next use
+    try:
+        monkeypatch.setenv("MXNET_TRACE_SAMPLE", "0.25")
+        assert xtrace.sample_rate() == 0.25
+        xtrace.set_sample_rate(None)
+        monkeypatch.setenv("MXNET_TRACE_SAMPLE", "7")
+        assert xtrace.sample_rate() == 1.0     # clamped into [0, 1]
+        xtrace.set_sample_rate(None)
+        monkeypatch.setenv("MXNET_TRACE_SAMPLE", "junk")
+        assert xtrace.sample_rate() == 1.0     # junk -> default
+    finally:
+        xtrace.set_sample_rate(prev)
+
+
+# -- tail-based capture -------------------------------------------------------
+
+def test_flagging_and_collect_spans():
+    ctx = xtrace.new_root(sampled=True)
+    with xtrace.activate(ctx):
+        with trace.span("xt::anomalous", step=7):
+            pass
+        entry = xtrace.flag_current("deadline_exceeded", note="m=x")
+    assert entry["trace_id"] == ctx.trace_id
+    flags = xtrace.flagged()
+    assert flags[-1]["kind"] == "deadline_exceeded"
+    assert flags[-1]["note"] == "m=x"
+    spans = xtrace.collect_spans(ctx.trace_id)
+    assert [e["name"] for e in spans] == ["xt::anomalous"]
+    # flag by bare id works; an empty id is refused
+    assert xtrace.flag(ctx.trace_id, "again")["trace_id"] == ctx.trace_id
+    assert xtrace.flag("", "nope") is None
+    # drain-on-read clears; plain read does not
+    assert xtrace.flagged(clear=True)
+    assert xtrace.flagged() == []
+
+
+def test_recorder_bundle_carries_flagged_trace_span_tree(tmp_path):
+    mon = telemetry.StepMonitor(warn_interval_s=1e9)
+    rec = telemetry.FlightRecorder(str(tmp_path), rank=0,
+                                   rate_limit_s=0.0)
+    rec.attach(mon)
+    ctx = xtrace.new_root(sampled=True)
+    with xtrace.activate(ctx):
+        with trace.span("xt::doomed_step"):
+            pass
+    xtrace.flag(ctx, "deadline_exceeded")
+    mon.record_anomaly("deadline_exceeded", "boom")
+    with open(rec.bundles[0]) as f:
+        bundle = json.load(f)
+    sec = bundle["xtrace"]
+    assert any(e["trace_id"] == ctx.trace_id for e in sec["flagged"])
+    assert [e["name"] for e in sec["spans"][ctx.trace_id]] \
+        == ["xt::doomed_step"]
+
+
+def test_gateway_deadline_exceeded_flags_trace_into_bundle(tmp_path):
+    """ISSUE 18 acceptance (local half): a deadline-exceeded request's
+    FlightRecorder bundle contains that request's span tree."""
+    from mxnet_tpu.serving import (DeadlineExceededError, ModelGateway,
+                                   ModelSpec)
+
+    mon = telemetry.StepMonitor(warn_interval_s=1e9)
+    rec = telemetry.FlightRecorder(str(tmp_path), rank=0,
+                                   rate_limit_s=0.0)
+    rec.attach(mon)
+    w = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    gw = ModelGateway(monitor=mon)
+    try:
+        gw.register(ModelSpec("xt_doomed_model",
+                              fn=lambda w, x: mx.nd.dot(x, w),
+                              params=[w], item_shape=(4,),
+                              max_batch=8))
+        gw.pause()
+        with xtrace.start(sampled=True) as ctx:
+            doomed = gw.submit("xt_doomed_model",
+                               np.ones((1, 4), np.float32),
+                               timeout_ms=30)
+        time.sleep(0.08)
+        gw.resume()
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=30)
+        deadline = time.time() + 10.0
+        while not rec.bundles and time.time() < deadline:
+            time.sleep(0.01)
+        assert rec.bundles, "no bundle captured for the shed request"
+        with open(rec.bundles[-1]) as f:
+            bundle = json.load(f)
+        assert bundle["meta"]["kind"] == "deadline_exceeded"
+        sec = bundle["xtrace"]
+        assert any(e["trace_id"] == ctx.trace_id for e in sec["flagged"])
+        spans = sec["spans"][ctx.trace_id]
+        assert spans, "flagged request has no span tree in the bundle"
+        assert all(e["args"]["trace_id"] == ctx.trace_id for e in spans)
+    finally:
+        gw.shutdown()
+
+
+def test_collect_trace_assembles_peer_spans_over_diag_channel(tmp_path):
+    """Cross-rank tail capture: rank 0 requests a flagged trace's spans
+    over the diag channel; peers push their local span trees; the
+    assembled view carries rank-stamped spans, and feed_recorder routes
+    it into subsequent bundles."""
+    bus = aggregate.LocalBus(num_workers=2)
+    recs, cols = [], []
+    for rank in (0, 1):
+        r = telemetry.FlightRecorder(
+            str(tmp_path / ("local%d" % rank)), rank=rank,
+            rate_limit_s=0.0)
+        recs.append(r)
+        cols.append(hp.DiagCollector(
+            bus.endpoint(rank), r, interval_s=0.0,
+            directory=str(tmp_path / "collected") if rank == 0 else None))
+    c0, c1 = cols
+    ctx = xtrace.new_root(sampled=True)
+    with xtrace.activate(ctx):
+        with trace.span("xt::pod_step"):
+            pass
+    with pytest.raises(ValueError):
+        c1.collect_trace(ctx.trace_id)       # rank-0-only entry point
+    stop = threading.Event()
+
+    def peer_loop():                         # rank 1's duty loop
+        while not stop.is_set():
+            c1.step()
+            time.sleep(0.005)
+
+    t = threading.Thread(target=peer_loop, daemon=True)
+    t.start()
+    try:
+        res = c0.collect_trace(ctx.trace_id, timeout_s=30.0)
+    finally:
+        stop.set()
+        t.join()
+    assert res["trace_id"] == ctx.trace_id
+    assert res["ranks"] == [0, 1]
+    assert {e["rank"] for e in res["spans"]} == {0, 1}
+    assert all(e["name"] == "xt::pod_step" for e in res["spans"])
+    # subsequent bundles carry the already-collected peer view
+    xtrace.flag(ctx, "slow_step")
+    c0.feed_recorder(recs[0])
+    path = recs[0].capture("manual", "inspect")
+    with open(path) as f:
+        peers = json.load(f)["extra"]["xtrace_peers"]
+    assert set(peers[ctx.trace_id]) == {"0", "1"} or \
+        set(peers[ctx.trace_id]) == {0, 1}
+
+
+# -- trace-anchored exemplars -------------------------------------------------
+
+def test_exemplars_record_trace_ids_on_histograms_and_counters():
+    reg = tmetrics.Registry()
+    lat = reg.histogram("xt_lat_seconds", "d", buckets=(0.1, 1.0))
+    red = reg.counter("xt_reduce_seconds_total", "d")
+    xtrace.install_exemplars(True)
+    try:
+        ctx = xtrace.new_root(sampled=True)
+        with xtrace.activate(ctx):
+            lat.observe(0.05)
+            red.inc(0.25)
+        assert red.exemplar[0] == ctx.trace_id
+        text = reg.render_prometheus(openmetrics=True)
+        bucket = [l for l in text.splitlines()
+                  if l.startswith("xt_lat_seconds_bucket") and " # " in l]
+        assert bucket and ctx.trace_id in bucket[0]
+        counter = [l for l in text.splitlines()
+                   if l.startswith("xt_reduce_seconds_total") and
+                   " # " in l]
+        assert counter and ctx.trace_id in counter[0]
+        # classic exposition never carries exemplar syntax
+        assert " # " not in reg.render_prometheus()
+        ex = tmetrics.collect_exemplars(reg)
+        by_metric = {e["metric"]: e for e in ex}
+        assert by_metric["xt_reduce_seconds_total"]["span_id"] \
+            == ctx.trace_id
+        assert "le" not in by_metric["xt_reduce_seconds_total"]
+        assert by_metric["xt_lat_seconds"]["span_id"] == ctx.trace_id
+    finally:
+        xtrace.install_exemplars(False)
+
+
+# -- profiler linkage ---------------------------------------------------------
+
+def test_continuous_profiler_tags_traced_threads_with_trace_leaf():
+    ctx = xtrace.new_root(sampled=True)
+    cold = xtrace.new_root(sampled=False)
+    stop = threading.Event()
+
+    def traced():
+        with xtrace.activate(ctx):
+            while not stop.is_set():
+                time.sleep(0.001)
+
+    def unsampled():
+        with xtrace.activate(cold):
+            while not stop.is_set():
+                time.sleep(0.001)
+
+    threads = [threading.Thread(target=traced, daemon=True),
+               threading.Thread(target=unsampled, daemon=True)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    profiler = telemetry.ContinuousProfiler(hz=100.0, window_s=3600.0)
+    try:
+        for _ in range(5):
+            profiler.sample()
+        text = profiler.collapsed()
+        tagged = [l for l in text.splitlines()
+                  if "trace:%s" % ctx.trace_id in l]
+        assert tagged, text
+        stack = tagged[0].rsplit(" ", 1)[0]
+        assert stack.endswith("trace:%s" % ctx.trace_id)  # the LEAF
+        assert "trace:%s" % cold.trace_id not in text     # unsampled
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        profiler.close()
+
+
+# -- POST /debug/xprof --------------------------------------------------------
+
+def test_xprof_endpoint_validation_and_capture(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_XPROF_DIR", raising=False)
+    bare = hp.HealthPlane()
+    status, body = bare.xprof(seconds=0.05)
+    assert status == 404 and "error" in body     # no capture root
+    assert bare.xprof(seconds="junk")[0] == 400
+
+    plane = hp.HealthPlane(xprof_dir=str(tmp_path / "prof"))
+    before = tmetrics.REGISTRY.get("mx_xprof_failures_total").value
+    status, body = plane.xprof(seconds=0.05)
+    if status == 200:
+        assert os.path.isdir(body["dir"])
+        assert body["dir"].startswith(str(tmp_path / "prof"))
+        assert body["seconds"] == 0.05
+    else:
+        # CPU-only jaxlib without a profiler backend degrades to 501
+        # and counts the failure — never crashes the plane
+        assert status == 501, (status, body)
+        assert tmetrics.REGISTRY.get("mx_xprof_failures_total").value \
+            == before + 1
+    # the POST route parses the query string like /debug/pprof does
+    assert plane.handle("POST", "/debug/xprof?seconds=abc")[0] == 400
+    assert plane.handle("POST", "/debug/xprof?seconds=0.05")[0] \
+        in (200, 501)
+    assert plane.handle("POST", "/nonsense") is None
+
+
+def test_xprof_concurrent_captures_conflict(tmp_path):
+    plane = hp.HealthPlane(xprof_dir=str(tmp_path))
+    assert plane._xprof_lock.acquire(blocking=False)
+    try:
+        status, body = plane.xprof(seconds=0.05)
+        assert status == 409
+    finally:
+        plane._xprof_lock.release()

@@ -1,0 +1,138 @@
+"""End-to-end causal tracing, 2-process acceptance (ISSUE 18): a
+gateway-shaped request and a training step against a REAL dist_sync
+kvstore each reconstruct as ONE connected flow in the merged Perfetto
+timeline — across the rooting worker's lane, the peer worker's lane
+(pull replies echo the applied round's context as ``link_trace_id``),
+and the server's lane (``kvstore::apply`` under the round context,
+``kvstore::serve_pull`` under the requester's context). Plus the
+kill-mid-segment case: flow ids live in event args, so committed
+anchors keep the flow connected after a SIGKILL and across a
+writer seq-resume."""
+import json
+import os
+import socket
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+from launch import launch_local  # noqa: E402
+
+_PROG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "xtrace_dist_prog.py")
+_BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+}
+
+
+def _can_bind_localhost():
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+def _launch(tmp_path, mode):
+    if not _can_bind_localhost():
+        pytest.skip("localhost sockets unavailable (multi-process "
+                    "kvstore needs them)")
+    env = dict(_BASE_ENV)
+    env["MXNET_TRACE_DIR"] = str(tmp_path)   # server streams its lane
+    return launch_local(
+        2, 1, [sys.executable, _PROG, str(tmp_path), mode],
+        env_extra=env, timeout=300)
+
+
+def _load(tmp_path):
+    with open(os.path.join(str(tmp_path), "trace_ids.json")) as f:
+        ids = json.load(f)
+    with open(os.path.join(str(tmp_path), "merged_trace.json")) as f:
+        events = json.load(f)["traceEvents"]
+    return ids, events
+
+
+def _anchors(events, trace_id):
+    """X slices stamped into this trace's flow — by ownership
+    (``trace_id``) or by service (``link_trace_id``)."""
+    out = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        if trace_id in (args.get("trace_id"), args.get("link_trace_id")):
+            out.append(e)
+    return out
+
+
+def _flows(events, trace_id):
+    return [e for e in events
+            if e.get("cat") == "xtrace" and e.get("id") == trace_id]
+
+
+def test_two_process_step_and_request_each_one_flow(tmp_path):
+    """ISSUE 18 acceptance: merged timeline from a 2-worker dist job
+    shows a single training step and a single gateway request each as
+    one flow spanning both worker lanes (and the server lane)."""
+    codes = _launch(tmp_path, "normal")
+    assert codes == [0, 0], codes
+    ids, events = _load(tmp_path)
+
+    # -- the training step: rooted on worker 0, one connected flow
+    step = _anchors(events, ids["step"])
+    named = {(e["name"], e["pid"]) for e in step}
+    assert ("xdist::train_step", 0) in named, named
+    assert ("kvstore::pull", 1) in named, named      # peer, via link
+    assert ("kvstore::apply", 2) in named, named     # server lane
+    # the slice recorded through a RESTARTED writer (seq-resume) still
+    # joined the same flow
+    assert ("xdist::post_resume", 0) in named, named
+    flows = _flows(events, ids["step"])
+    assert {f["ph"] for f in flows} == {"s", "t", "f"}, flows
+    assert {f["pid"] for f in flows} >= {0, 1, 2}
+    assert sum(1 for f in flows if f["ph"] == "s") == 1
+    finish = [f for f in flows if f["ph"] == "f"]
+    assert len(finish) == 1 and finish[0]["bp"] == "e"
+    # arrows step forward in time
+    ts = [f["ts"] for f in sorted(flows, key=lambda f: f["ts"])]
+    assert ts == sorted(f["ts"] for f in flows)
+
+    # -- the gateway request: its flow reaches the server lane through
+    # kvstore::serve_pull (no apply ran for it)
+    gw = _anchors(events, ids["gateway"])
+    gnamed = {(e["name"], e["pid"]) for e in gw}
+    assert ("xdist::gateway_request", 0) in gnamed, gnamed
+    assert ("xdist::gateway_device", 0) in gnamed
+    assert ("kvstore::serve_pull", 2) in gnamed, gnamed
+    gflows = _flows(events, ids["gateway"])
+    assert {f["pid"] for f in gflows} >= {0, 2}
+    assert {f["ph"] for f in gflows} >= {"s", "f"}
+
+    # the two traces are distinct flows, not one blob
+    assert ids["step"] != ids["gateway"]
+    lanes = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert {"rank 0", "rank 1", "rank 2"} <= lanes, lanes
+
+
+def test_two_process_sigkill_keeps_committed_flow_anchors(tmp_path):
+    """SIGKILL of the peer mid-segment: its committed link-stamped
+    pull slice keeps the step flow connected across both worker lanes;
+    the never-committed span is gone."""
+    codes = _launch(tmp_path, "kill")
+    # kv ranks come from scheduler registration order, so EITHER worker
+    # process may have drawn rank 1 (the SIGKILLed one).
+    assert sorted(codes) == [-9, 0], codes
+    ids, events = _load(tmp_path)
+    step = _anchors(events, ids["step"])
+    named = {(e["name"], e["pid"]) for e in step}
+    assert ("xdist::train_step", 0) in named, named
+    assert ("kvstore::pull", 1) in named, named
+    flows = _flows(events, ids["step"])
+    assert {f["pid"] for f in flows} >= {0, 1}
+    assert not any(e.get("name") == "xdist::never_committed"
+                   for e in events)

@@ -1,0 +1,144 @@
+"""Worker program for the 2-process causal-tracing acceptance test
+(tests/test_xtrace_dist.py, launched via tools/launch.py roles).
+
+Rank 0 roots two sampled traces against a real dist_sync kvstore:
+
+* a training step — push into the sync round, pull the result. The
+  server adopts the round's wire context (``kvstore::apply`` joins the
+  flow on the server lane) and echoes it on pull replies, so the PEER
+  rank's ``kvstore::pull`` slice gets ``link_trace_id`` stamped: one
+  flow across worker 0, worker 1, and the server.
+* a gateway-shaped request — request/device spans around a backend
+  pull. The server records ``kvstore::serve_pull`` under the request's
+  wire context: the request's flow reaches the server lane even though
+  no apply ran for it.
+
+Rank 0 then restarts its streaming writer (seq-resume) and records one
+more span under the SAME step context — flow ids live in event args,
+so the post-resume slice must still join the step's flow — flushes the
+server lane over the command channel, and merges everything into one
+Perfetto timeline.
+
+Modes:
+
+* ``normal`` — both ranks run to completion.
+* ``kill`` — rank 1 SIGKILLs itself after committing its link-stamped
+  pull slice (with another span buffered that never commits); rank 0
+  must still merge one connected step flow from the committed anchors.
+"""
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx                                 # noqa: E402
+from mxnet_tpu import telemetry                        # noqa: E402
+from mxnet_tpu.telemetry import trace, xtrace          # noqa: E402
+
+SHAPE = (8,)
+
+
+def _wait_for_segments(out_dir, rank, deadline_s=60.0):
+    """Block until a committed segment of ``rank`` exists (the peer's
+    flush and rank 0's merge race in kill mode, where no barrier can
+    order them)."""
+    prefix = "trace.rank%d." % rank
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if any(f.startswith(prefix) and f.endswith(".jsonl")
+               for f in os.listdir(out_dir)):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def main():
+    out_dir = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "normal"
+    kv = mx.kvstore.create("dist_sync")
+    rank = kv.rank
+    xtrace.set_sample_rate(1.0)
+    writer = telemetry.StreamingTraceWriter(
+        out_dir, rank=rank, max_segment_age_s=0.0)  # commit every tick
+
+    kv.init("w", mx.nd.zeros(SHAPE))
+    kv._barrier()               # both inits landed before any push
+    out = mx.nd.zeros(SHAPE)
+    ids = {}
+
+    if rank == 0:
+        # One training step, rooted here; the peer joins context-free.
+        step_ctx = xtrace.new_root(sampled=True)
+        ids["step"] = step_ctx.trace_id
+        with xtrace.activate(step_ctx):
+            with trace.span("xdist::train_step", rank=rank):
+                kv.push("w", mx.nd.ones(SHAPE))
+                kv.pull("w", out=out)
+        writer.tick()
+        # One gateway-shaped request: spans around a backend pull. The
+        # server side joins via kvstore::serve_pull, not via an apply.
+        gw_ctx = xtrace.new_root(sampled=True)
+        ids["gateway"] = gw_ctx.trace_id
+        with xtrace.activate(gw_ctx):
+            with trace.span("xdist::gateway_request", rank=rank):
+                with trace.span("xdist::gateway_device", rank=rank):
+                    kv.pull("w", out=out)
+        writer.tick()
+        # Seq-resume: a restarted writer EXTENDS the segment set; a
+        # span of the SAME trace recorded afterwards still joins its
+        # flow (trace ids live in event args, not per-segment state).
+        writer.close()
+        writer = telemetry.StreamingTraceWriter(
+            out_dir, rank=rank, max_segment_age_s=0.0)
+        with xtrace.activate(step_ctx):
+            with trace.span("xdist::post_resume", rank=rank):
+                pass
+        writer.flush()
+    else:
+        # The peer's push closes the sync round; its pull reply echoes
+        # the applied round's context -> link_trace_id on the slice.
+        with trace.span("xdist::peer_step", rank=rank):
+            kv.push("w", mx.nd.ones(SHAPE))
+            kv.pull("w", out=out)
+        writer.flush()
+        if mode == "kill":
+            # Committed link anchor exists; buffer one more span that
+            # never commits, then die without any cleanup at all.
+            with trace.span("xdist::never_committed"):
+                pass
+            os.kill(os.getpid(), 9)
+
+    if rank != 0:
+        kv._barrier()
+        return 0
+
+    if mode != "kill":
+        kv._barrier()           # the peer's flush has landed
+    elif not _wait_for_segments(out_dir, 1):
+        print("no committed segment from rank 1", file=sys.stderr)
+        return 3
+
+    # Commit the server lane's pending spans NOW (its writer's age
+    # budget would otherwise hold them until shutdown), then merge.
+    kv.server_profiler_command("trace_flush")
+
+    with open(os.path.join(out_dir, "trace_ids.json"), "w") as f:
+        json.dump(ids, f)
+
+    import trace_merge
+
+    trace_merge.merge([out_dir],
+                      out=os.path.join(out_dir, "merged_trace.json"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

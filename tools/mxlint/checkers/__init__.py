@@ -11,6 +11,7 @@ from .signals import SignalChecker
 from .staleknobs import StaleKnobChecker
 from .telemetry_names import TelemetryNameChecker
 from .threads import ThreadChecker
+from .trace_propagation import TracePropagationChecker
 from .writes import WriteChecker
 
 # Construction order == report/documentation order.
@@ -22,6 +23,7 @@ ALL_CHECKERS = (
     StaleKnobChecker,
     ThreadChecker,
     TelemetryNameChecker,
+    TracePropagationChecker,
 )
 
 # Selectable names (--check=...): a checker may emit secondary finding
@@ -35,6 +37,7 @@ CHECKS = {
     "stale-knob": StaleKnobChecker,
     "thread-lifecycle": ThreadChecker,
     "telemetry-naming": TelemetryNameChecker,
+    "trace-propagation": TracePropagationChecker,
 }
 
 __all__ = ["ALL_CHECKERS", "CHECKS"]

@@ -1,0 +1,76 @@
+"""Checker: trace-context propagation on kvstore command payloads.
+
+The end-to-end causal tracing story only holds if EVERY cross-process
+payload carries the wire context: one command that forgets it breaks
+the merged Perfetto flow for every trace that crosses it (the arrow
+chain just stops at that hop), and nothing fails loudly — the timeline
+is silently disconnected. Enforced:
+
+- every tuple-literal command payload handed to the dist transport
+  (``*._post(server, ("cmd", ...))`` / ``*._call(server, ("cmd",
+  ...))``) includes a trace context element: an ``xtrace.inject()``
+  call, or a name whose last segment mentions ``ctx`` (an already
+  extracted/forwarded wire context).
+
+Ad-hoc dict keys or out-of-band side channels do not count — the wire
+format IS the API (``xtrace.inject``/``extract`` version the tuple
+layout so peers never parse each other's internals). Payloads built
+elsewhere and passed by name are opaque to this checker (the build
+site is where the tuple literal — and the finding — lives).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted
+from ..core import Checker, Finding
+
+_TRANSPORT = {"_post", "_call"}
+
+
+class TracePropagationChecker(Checker):
+    name = "trace-propagation"
+    description = ("kvstore dist command payloads carry a trace context "
+                   "via xtrace.inject()/an extracted ctx, not ad-hoc "
+                   "keys")
+
+    def check_module(self, mod):
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func) or ""
+            if callee.split(".")[-1] not in _TRANSPORT:
+                continue
+            for arg in node.args:
+                if not isinstance(arg, ast.Tuple) or not arg.elts:
+                    continue
+                first = arg.elts[0]
+                if not (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    continue
+                if not self._carries_ctx(arg):
+                    findings.append(Finding(
+                        mod.relpath, arg.lineno, self.name,
+                        "command payload (%r, ...) carries no trace "
+                        "context — append xtrace.inject() (or forward "
+                        "an extracted ctx) so the hop keeps the causal "
+                        "chain connected" % first.value))
+        return findings
+
+    @staticmethod
+    def _carries_ctx(tup):
+        """Does a payload tuple literal include a context element? An
+        ``inject(...)`` call or any ``*ctx*``-named element counts; a
+        ``*splice`` is opaque (absence is unprovable), so it passes."""
+        for el in tup.elts:
+            if isinstance(el, ast.Starred):
+                return True
+            if isinstance(el, ast.Call):
+                callee = dotted(el.func) or ""
+                if callee.split(".")[-1] == "inject":
+                    return True
+            name = dotted(el) or ""
+            if name and "ctx" in name.split(".")[-1].lower():
+                return True
+        return False

@@ -20,7 +20,13 @@ with **one process lane per rank**:
   wall-rebased lanes (true cross-source offsets are unknowable without
   anchors);
 * truncated or foreign lines are skipped, never fatal (a merge of a
-  crashed job must succeed on whatever was committed).
+  crashed job must succeed on whatever was committed);
+* events stamped with an xtrace ``trace_id`` (or ``link_trace_id``)
+  are connected with Perfetto flow events (`ph` s/t/f, one arrow
+  chain per trace) so a causal chain — gateway request, trainer
+  push→apply→pull round trip — renders as ONE flow across rank lanes;
+* a segment header's ``dropped`` count (spans lost to ring overflow)
+  becomes a ``trace::dropped_spans`` instant annotating the gap.
 
 Usage::
 
@@ -82,6 +88,41 @@ def _iter_records(path):
                 continue        # torn tail / foreign line
 
 
+def _flow_events(out_events):
+    """Synthesize Perfetto flow events (``ph`` s/t/f) from xtrace
+    context stamps so cross-rank causal chains render as connected
+    arrows. Every slice stamped with a ``trace_id`` (its own trace) or
+    a ``link_trace_id`` (a foreign trace it served — e.g. a pull reply
+    carrying the round's context) joins that trace's flow; the flow
+    steps through the stamped slices in time order, one arrow chain
+    per trace across however many rank lanes it touched."""
+    by_trace = {}
+    for e in out_events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        for key in ("trace_id", "link_trace_id"):
+            trace_id = args.get(key)
+            if trace_id:
+                by_trace.setdefault(trace_id, []).append(e)
+    flows = []
+    for trace_id in sorted(by_trace):
+        anchors = by_trace[trace_id]
+        if len(anchors) < 2:
+            continue            # a single-slice trace has no arrow
+        anchors.sort(key=lambda e: (e["ts"], e["pid"], e.get("tid", 0)))
+        last = len(anchors) - 1
+        for i, e in enumerate(anchors):
+            ph = "s" if i == 0 else ("f" if i == last else "t")
+            flow = {"ph": ph, "cat": "xtrace", "name": "xtrace::flow",
+                    "id": trace_id, "pid": e["pid"],
+                    "tid": e.get("tid", 0), "ts": e["ts"]}
+            if ph == "f":
+                flow["bp"] = "e"    # bind the finish to the enclosing slice
+            flows.append(flow)
+    return flows
+
+
 def merge(paths, out=None):
     """Merge segment/dump files into one trace-event dict (written
     atomically to ``out`` when given). Returns the dict."""
@@ -93,6 +134,8 @@ def merge(paths, out=None):
         m = SEG_RE.search(os.path.basename(path))
         rank = int(m.group(1)) if m else None
         anchor = None
+        dropped = 0                 # ring-overflow gap before this segment
+        first = None                # (lane, domain, ts, tid) of first event
         for rec in _iter_records(path):
             meta = rec.get("meta") if isinstance(rec, dict) else None
             if meta is not None:
@@ -101,6 +144,10 @@ def merge(paths, out=None):
                 if "wall_anchor_us" in meta and "perf_anchor_us" in meta:
                     anchor = (float(meta["wall_anchor_us"]),
                               float(meta["perf_anchor_us"]))
+                try:
+                    dropped += int(meta.get("dropped", 0))
+                except (TypeError, ValueError):
+                    pass
                 continue
             if not isinstance(rec, dict) or "ph" not in rec:
                 continue
@@ -125,7 +172,18 @@ def merge(paths, out=None):
             # cross-rank offsets are real); each anchorless file is its
             # own domain, aligned at its first event below.
             domain = "wall" if anchor is not None else file_idx
+            if first is None:
+                first = (lane, domain, ts, rec.get("tid", 0))
             events.append((lane, domain, ts, dict(rec)))
+        if dropped and first is not None:
+            # The segment header said spans were lost to ring overflow
+            # before this segment — annotate the gap where it sits
+            # instead of splicing the lane silently.
+            lane, domain, ts, tid = first
+            events.append((lane, domain, ts,
+                           {"ph": "i", "name": "trace::dropped_spans",
+                            "tid": tid, "s": "t",
+                            "args": {"dropped": dropped}}))
         anon += 1
 
     # Lane ids must be integers for the chrome format: ranks keep their
@@ -157,6 +215,7 @@ def merge(paths, out=None):
         event["pid"] = pid_of[lane]
         event["ts"] = ts - t0[domain]
         out_events.append(event)
+    out_events.extend(_flow_events(out_events))
 
     merged = {"traceEvents": out_events, "displayTimeUnit": "ms"}
     if out is not None:
