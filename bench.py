@@ -318,6 +318,118 @@ def _serving_gateway_rows():
         gw.shutdown()
 
 
+def _continuous_batching_rows():
+    """Continuous batching section (mxnet_tpu.serving.continuous,
+    ISSUE 19): iteration-level slot scheduling vs a static batch on the
+    SAME backend at a geometric sequence-length mix. THE CONTRACT ROWS:
+
+    - continuous_batching_tokens_per_sec_speedup >= 2.0 — the static
+      regime steps every batch max(L) times to earn mean(L) tokens per
+      slot; per-iteration retire/admit reclaims the difference;
+    - decode_steady_state_retraces == 0 — compile count flat across
+      the whole run (>= 100 steps of admit/retire churn) after warm().
+
+    Plus an informative p99 TTFT row while the batch is saturated.
+    """
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import DecodeConfig, DecodeLoop, ModelSpec
+    from mxnet_tpu.telemetry import metrics as _tm
+
+    H, B, N, REPS = 1536, 32, 384, 3
+    rng = np.random.RandomState(3)
+    w = mx.nd.array((rng.rand(H, H).astype(np.float32) - 0.5) * 0.05)
+
+    def step(w_, state, tokens, pos):
+        return mx.nd.tanh(mx.nd.dot(state, w_)), tokens + 1
+
+    spec = ModelSpec(
+        "bench_decode", params=[w], max_batch=B,
+        decode=DecodeConfig(step, state_shape=(H,), page_slots=4,
+                            max_tokens=128))
+    backend = spec.build_backend()
+    backend.warm()
+    warm_compiles = backend.compile_count
+    # Geometric length mix: many short, a heavy tail of long — the
+    # regime static batching wastes (each batch runs max(L) steps over
+    # the FULL batch width, mostly on rows that already finished).
+    lengths = np.clip(
+        np.random.RandomState(7).geometric(1 / 10.0, size=N), 1, 128)
+    total_tokens = int(lengths.sum())
+
+    def static_pass():
+        # Static baseline: same backend, batch-synchronous — admit B
+        # sequences, step until the LONGEST finishes, repeat.
+        # Admission (slot-state init) is paid per sequence in both
+        # regimes; past that the inline loop has strictly less host
+        # overhead than the scheduler, so the comparison is
+        # conservative.
+        tokens = np.zeros(B, np.int32)
+        pos = np.zeros(B, np.int32)
+        steps = 0
+        t0 = time.perf_counter()
+        for i in range(0, N, B):
+            batch = lengths[i:i + B]
+            n_pages = backend.page_count(len(batch))
+            active = np.zeros(B, bool)
+            for slot in range(len(batch)):
+                tokens[slot] = backend.admit(
+                    slot, np.asarray([1], np.int32))
+            for s in range(int(batch.max())):
+                active[:len(batch)] = s < batch
+                backend.step(n_pages, tokens, pos, active)
+                steps += 1
+        return time.perf_counter() - t0, steps
+
+    steps_fam = _tm.REGISTRY.get("mx_decode_steps_total")
+
+    def continuous_pass():
+        steps0 = steps_fam.labels(model="bench_decode").value
+        loop = DecodeLoop(spec, backend)
+        try:
+            t0 = time.perf_counter()
+            seqs = [loop.submit([int(n) % 97 + 1], max_tokens=int(n))
+                    for n in lengths]
+            for s in seqs:
+                s.future.result(timeout=300)
+            dt = time.perf_counter() - t0
+            steps = int(steps_fam.labels(model="bench_decode").value
+                        - steps0)
+            p99 = loop.stats()["p99_ttft_ms"]
+        finally:
+            loop.close()
+        return dt, steps, p99
+
+    # Paired repetitions, median speedup — the same median-of-windows
+    # discipline as the training rows (robust to shared-CPU noise).
+    runs = []
+    for _ in range(REPS):
+        static_s, static_steps = static_pass()
+        cont_s, cont_steps, p99_ttft = continuous_pass()
+        runs.append((cont_s, static_s, cont_steps, static_steps,
+                     p99_ttft))
+    cont_s, static_s, cont_steps, static_steps, p99_ttft = sorted(
+        runs, key=lambda r: r[1] / r[0])[REPS // 2]
+    static_tps = total_tokens / static_s
+    cont_tps = total_tokens / cont_s
+
+    _emit("decode_tokens_per_sec_continuous", round(cont_tps, 1),
+          "tok/s")
+    _emit("decode_tokens_per_sec_static", round(static_tps, 1), "tok/s")
+    # THE CONTRACT ROW (>= 2.0).
+    _emit("continuous_batching_tokens_per_sec_speedup",
+          round(cont_tps / static_tps, 3), "x")
+    # THE CONTRACT ROW (== 0): zero retraces across every static sweep
+    # AND >= 100 continuous steps of admit/retire churn per rep, all
+    # post-warm.
+    _emit("decode_steady_state_retraces",
+          int(backend.compile_count - warm_compiles), "compiles")
+    _emit("decode_churn_steps", cont_steps, "steps")
+    _emit("decode_static_steps", static_steps, "steps")
+    _emit("decode_warm_compiles", warm_compiles, "compiles")
+    # Informative: admission latency while every slot is contended.
+    _emit("decode_p99_ttft_ms", round(p99_ttft, 2), "ms")
+
+
 def _telemetry_rows():
     """Telemetry section (mxnet_tpu.telemetry): instrumentation overhead
     on the step path. The SAME TrainStep loop is timed with telemetry
@@ -1063,7 +1175,10 @@ def compare(a_path, b_path):
     for metric, unit in (("fused_overlap_efficiency", "share"),
                          ("trainer_fused_update_speedup", "x"),
                          ("gateway_swap_dropped_requests", "req"),
-                         ("gateway_protected_p99_ms", "ms")):
+                         ("gateway_protected_p99_ms", "ms"),
+                         ("continuous_batching_tokens_per_sec_speedup",
+                          "x"),
+                         ("decode_steady_state_retraces", "compiles")):
         if metric in a or metric in b:
             va = float(a.get(metric, {}).get("value", 0) or 0)
             vb = float(b.get(metric, {}).get("value", 0) or 0)
@@ -1615,6 +1730,12 @@ def main():
         _serving_gateway_rows()
     except Exception:
         print("bench serving_gateway section failed:", file=sys.stderr)
+        traceback.print_exc()
+    try:
+        _continuous_batching_rows()
+    except Exception:
+        print("bench continuous_batching section failed:",
+              file=sys.stderr)
         traceback.print_exc()
     try:
         _telemetry_rows()

@@ -159,19 +159,29 @@ def shared_filesystem():
     return bool(_env.get("MXNET_COMPILE_CACHE_SHARED"))
 
 
-def attach_kvstore(kv):
+def attach_kvstore(kv, prefetch=True):
     """Convenience: wire a :class:`.distribute.CacheDistributor` over a
     kvstore-shaped transport (``KVStoreDist`` or a LocalBus endpoint
     with the ``cc_*`` commands). No-op returning None when the cache is
     disabled — or in shared-filesystem mode
     (``MXNET_COMPILE_CACHE_SHARED=1``), where the common cache
     directory already distributes entries and the kvstore channel would
-    only duplicate bytes."""
+    only duplicate bytes.
+
+    By default the attach also PREFETCHES: one ``cc_probe(None)``
+    round enumerates every entry the rendezvous holds, and the ones
+    missing from this rank's disk store are pulled and committed
+    immediately — an elastic joiner warms its store before the first
+    trace instead of discovering entries miss-by-miss. Pass
+    ``prefetch=False`` to attach lazily."""
     if not enabled() or shared_filesystem():
         return None
     from .distribute import CacheDistributor
 
-    return set_distributor(CacheDistributor(kv))
+    dist = set_distributor(CacheDistributor(kv))
+    if prefetch:
+        dist.prefetch(active_store())
+    return dist
 
 
 def _active_distributor():

@@ -39,6 +39,9 @@ _pushed_total = _tm.REGISTRY.counter(
 _pulled_total = _tm.REGISTRY.counter(
     "mx_compile_cache_pulled_total",
     "Compile-cache entries fetched from the pod over the kvstore")
+_prefetched_total = _tm.REGISTRY.counter(
+    "mx_compile_cache_prefetched_total",
+    "Compile-cache entries bulk-warmed into the local store at attach")
 
 _logger = _log.get_logger("mxnet_tpu.compile")
 
@@ -88,9 +91,64 @@ class CacheDistributor:
         _pushed_total.inc()
         return True
 
-    def probe(self, keys):
-        """Subset of ``keys`` the pod currently holds."""
-        return self._kv.cc_probe(list(keys))
+    def probe(self, keys=None):
+        """Subset of ``keys`` the pod currently holds; ``None``
+        enumerates EVERY held key in one round-trip."""
+        return self._kv.cc_probe(None if keys is None else list(keys))
+
+    def prefetch(self, store):
+        """Bulk-warm ``store`` from the pod: ONE ``cc_probe(None)``
+        round enumerates every entry the rendezvous holds, then each
+        key absent from the local disk store is pulled and committed —
+        a joiner warms its whole store before the first trace instead
+        of paying a probe round-trip per miss. Best-effort: transport
+        or commit failures degrade to the ordinary miss-by-miss path.
+        Returns the number of entries committed."""
+        if store is None or not self.pulls:
+            return 0
+        try:
+            held = self.probe(None)
+        except Exception as exc:
+            _log.warn_rate_limited(
+                _logger, "cc_prefetch:%d" % id(self), 60.0,
+                "compile-cache prefetch probe failed (falling back to "
+                "miss-by-miss pulls): %s", exc)
+            return 0
+        have = set(store.keys())
+        committed = 0
+        for key in held:
+            if key in have:
+                continue
+            try:
+                rec = self._kv.cc_pull(key)
+            except Exception as exc:
+                _log.warn_rate_limited(
+                    _logger, "cc_prefetch:%d" % id(self), 60.0,
+                    "compile-cache prefetch pull failed after %d "
+                    "entries (remainder falls back to miss-by-miss "
+                    "pulls): %s", committed, exc)
+                break
+            if rec is None:
+                continue                # raced a buffer eviction
+            meta, payload = rec
+            try:
+                store.put(key, payload, meta)
+            except OSError as exc:
+                # Disk trouble hits every later put too — stop, don't
+                # grind through the rest of the listing.
+                _log.warn_rate_limited(
+                    _logger, "cc_prefetch:%d" % id(self), 60.0,
+                    "compile-cache prefetch commit failed after %d "
+                    "entries (store stays partially warm): %s",
+                    committed, exc)
+                break
+            committed += 1
+            _prefetched_total.inc()
+        if committed:
+            _logger.info("compile-cache prefetch warmed %d entr%s from "
+                         "the pod rendezvous", committed,
+                         "y" if committed == 1 else "ies")
+        return committed
 
     def fetch(self, key):
         """``(meta, payload)`` from the pod, or None. One probe first so

@@ -79,6 +79,20 @@ CATALOGUE = [
          "hot reload: how long swap_backend waits for in-flight "
          "batches of the old generation to drain before returning "
          "with the old executables still referenced", False),
+    Knob("MXNET_DECODE_PAGE_SLOTS", int, 8, "serving/continuous.py",
+         "continuous batching: batch slots per state page (the paged "
+         "per-slot state granularity; step executables cover whole "
+         "pages, so smaller pages track occupancy tighter at more "
+         "executable signatures)", False),
+    Knob("MXNET_DECODE_MAX_TOKENS", int, 128, "serving/continuous.py",
+         "continuous batching: default generation cap per sequence "
+         "(submit_sequence(max_tokens=) overrides per request)", False),
+    Knob("MXNET_DECODE_IDLE_POLL_MS", float, 20.0,
+         "serving/continuous.py",
+         "continuous batching: DecodeLoop idle wait between wakeup "
+         "checks when no slot is occupied and nothing is queued "
+         "(enqueues notify immediately; this only bounds the fallback "
+         "poll)", False),
     Knob("MXNET_PROFILER_AUTOSTART", int, 0, "profiler.py",
          "start device+dispatch profiling at import", False),
     Knob("MXNET_PROFILE_HZ", float, 67.0, "telemetry/profiling.py",

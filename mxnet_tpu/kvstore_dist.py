@@ -25,6 +25,7 @@ on the server; `dist_async` updates per push with no barrier.
 from __future__ import annotations
 
 import atexit
+import contextlib
 import os
 import pickle
 import queue
@@ -70,12 +71,17 @@ class KVStoreDist(KVStoreLocal):
         self._compression = None
         self._closed = False
 
-        # One lock serializes every server-connection exchange: the
-        # request/reply framing is per-connection, so the Trainer's
+        # Per-SERVER comm locks (created once the addressbook arrives):
+        # the request/reply framing is per-connection, so the Trainer's
         # overlap pipeline (pushes from its comm thread, pulls from the
-        # async-pull thread) must never interleave messages with each
-        # other or with foreground RPCs. Reentrant: push → _post nests.
-        self._comm_lock = threading.RLock()
+        # async-pull thread) must never interleave messages on ONE
+        # connection — but a push to server B has no business waiting
+        # on a pull parked at server A. One RLock per server serializes
+        # whole exchanges per connection while different servers
+        # proceed concurrently; multi-server operations (sharded fetch)
+        # take their locks in ascending server order. Reentrant:
+        # push → _post → _drain_acks nests on the same server's lock.
+        self._comm_locks = []
         self._pull_q = None
         self._pull_thread = None
         # Linearizes pull_async enqueues against close()'s shutdown
@@ -100,6 +106,7 @@ class KVStoreDist(KVStoreLocal):
         book = self._sched.recv()
         assert book[0] == "addressbook"
         self._servers = [_client(addr) for addr in book[1]]
+        self._comm_locks = [threading.RLock() for _ in self._servers]
         self._pending_acks = [0] * len(self._servers)
         for conn in self._servers:
             conn.send(("hello", self._sync, self._rank))
@@ -207,7 +214,7 @@ class KVStoreDist(KVStoreLocal):
 
     def _post(self, server_idx, msg):
         """Fire-and-collect-later send; reply must be a plain ack."""
-        with self._comm_lock:
+        with self._comm_locks[server_idx]:
             if self._pending_acks[server_idx] >= self._MAX_PENDING_ACKS:
                 self._drain_acks(server_idx)
             try:
@@ -218,18 +225,21 @@ class KVStoreDist(KVStoreLocal):
             self._pending_acks[server_idx] += 1
 
     def _drain_acks(self, server_idx=None):
-        """Collect outstanding acks (surfacing any deferred errors)."""
+        """Collect outstanding acks (surfacing any deferred errors).
+        Each server drains under its OWN lock — a slow ack collection
+        on one connection never parks traffic to the others."""
         idxs = [server_idx] if server_idx is not None \
             else range(len(self._servers))
-        with self._comm_lock:
-            for i in idxs:
+        for i in idxs:
+            with self._comm_locks[i]:
                 conn = self._servers[i]
                 while self._pending_acks[i]:
                     try:
                         # mxlint: disable=lock-blocking -- ack drain
-                        # holds the comm lock so no other thread can
-                        # send mid-drain and misframe the stream;
-                        # per-server locks are a ROADMAP follow-up
+                        # holds THIS server's comm lock so no other
+                        # thread can send mid-drain and misframe this
+                        # connection's stream; other servers' traffic
+                        # proceeds under their own locks
                         reply = conn.recv()
                     except (OSError, EOFError):
                         # Server died with acks in flight; reconnect and
@@ -248,7 +258,7 @@ class KVStoreDist(KVStoreLocal):
         (a list) collects the reply's trailing wire trace context, when
         the server sent one (pull replies carry the context of the sync
         round that produced the value)."""
-        with self._comm_lock:
+        with self._comm_locks[server_idx]:
             self._drain_acks(server_idx)
             for attempt in (0, 1):
                 conn = self._servers[server_idx]
@@ -389,7 +399,15 @@ class KVStoreDist(KVStoreLocal):
                 shards[0][0], ("pull", shards[0][1], _xtrace.inject()),
                 ctx_out=ctx_out)).reshape(shape)
         else:
-            with self._comm_lock:
+            # Multi-server fetch: hold every involved server's lock for
+            # the whole issue-all-then-collect exchange. Ascending
+            # server order is the fixed acquisition order repo-wide —
+            # any two threads taking multiple comm locks take them in
+            # the same sequence, so sharded fetches never deadlock
+            # against each other or against single-server RPCs.
+            with contextlib.ExitStack() as stack:
+                for sidx in sorted({s[0] for s in shards}):
+                    stack.enter_context(self._comm_locks[sidx])
                 value = self._fetch_sharded(k, shape, dtype, shards,
                                             ctx_out)
         self._pull_span(k, t0, ctx_out)
@@ -504,10 +522,11 @@ class KVStoreDist(KVStoreLocal):
         server may PARK until every worker pushed the key) runs on a
         dedicated puller thread, so the CALLER is free — the Trainer's
         main thread keeps unflattening/dispatching fused applies while
-        the pull is in flight. Wire-level push/pull overlap is NOT
-        claimed: the per-store comm lock serializes whole exchanges so
-        replies never interleave on a connection (per-server locks are
-        the ROADMAP follow-up that would pipeline the wire itself)."""
+        the pull is in flight. Cross-SERVER wire overlap is real: comm
+        locks are per server, so this pull proceeds while pushes target
+        other servers. On any ONE connection the lock still serializes
+        whole exchanges — replies carry no request ids, so framing
+        safety requires it."""
         handle = PullHandle()
         with self._pull_lifecycle:
             if self._closed:
@@ -641,9 +660,13 @@ class KVStoreDist(KVStoreLocal):
         self._post(0, ("cc_push", key, meta, blob,
                           _xtrace.inject()))
 
-    def cc_probe(self, keys):
-        """Which of ``keys`` the pod rendezvous currently holds."""
-        return self._call(0, ("cc_probe", list(keys), _xtrace.inject()))
+    def cc_probe(self, keys=None):
+        """Which of ``keys`` the pod rendezvous currently holds;
+        ``None`` enumerates EVERY held key (one round-trip for a
+        joiner's whole-store prefetch)."""
+        return self._call(0, ("cc_probe",
+                              None if keys is None else list(keys),
+                              _xtrace.inject()))
 
     def cc_pull(self, key):
         """Fetch one entry: ``(meta, blob)`` or None."""

@@ -644,7 +644,10 @@ class KVStoreServer:
                     self._cc_bytes -= len(self._cc.pop(oldest)[1])
             self._send(conn, ("ok",))
         elif cmd == "cc_probe":
-            self._send(conn, ("val",
+            # keys=None enumerates every held key — the one-round
+            # whole-buffer listing a joiner's prefetch rides.
+            self._send(conn, ("val", list(self._cc)
+                              if msg[1] is None else
                               [k for k in msg[1] if k in self._cc]))
         elif cmd == "cc_pull":
             self._send(conn, ("val", self._cc.get(msg[1])))

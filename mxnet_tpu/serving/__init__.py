@@ -27,11 +27,22 @@ reload)::
     res = gw.predict("mnist", x)         # GatewayResult: .output,
     serving.hot_swap(gw, "mnist", params=w2)   # .generation, .model
     gw.shutdown()
+
+Stateful sequence models (continuous batching — iteration-level slot
+scheduling with paged per-slot state, :mod:`.continuous`)::
+
+    gw.register(serving.ModelSpec(
+        "lm", decode=serving.DecodeConfig(step, state_shape=(64,)),
+        max_batch=16))
+    seq = gw.generate("lm", prompt_ids)  # SequenceResult: .tokens,
+    gw.shutdown()                        # .ttft_s, .generation
 """
 from .admission import AdmissionController, DeadlineExceededError, \
     QueueFullError, ServiceUnavailableError
 from .batcher import DynamicBatcher
 from .buckets import BucketPolicy
+from .continuous import DecodeConfig, DecodeLoop, PagedSlotAllocator, \
+    SequenceResult
 from .engine import InferenceServer
 from .gateway import GatewayResult, ModelGateway
 from .metrics import ServingMetrics
@@ -43,4 +54,6 @@ __all__ = ["InferenceServer", "BucketPolicy", "DynamicBatcher",
            "ServingMetrics", "AdmissionController", "QueueFullError",
            "DeadlineExceededError", "ServiceUnavailableError",
            "ModelGateway", "GatewayResult", "ModelRegistry", "ModelSpec",
-           "QuantizedFnModel", "MeshShardedModel", "hot_swap"]
+           "QuantizedFnModel", "MeshShardedModel", "hot_swap",
+           "DecodeConfig", "DecodeLoop", "PagedSlotAllocator",
+           "SequenceResult"]

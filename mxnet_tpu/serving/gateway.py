@@ -61,6 +61,8 @@ from ..telemetry import xtrace as _xtrace
 from ..telemetry.slo import BurnRateMonitor, ServiceLevelObjective
 from .admission import QueueFullError, ServiceUnavailableError, \
     DeadlineExceededError
+from .continuous import DecodeLoop
+from .continuous import drop_metrics as _drop_decode_metrics
 from .registry import ModelRegistry, ModelSpec
 
 __all__ = ["ModelGateway", "GatewayResult"]
@@ -138,7 +140,7 @@ class _GwRequest:
 class _ModelState:
     __slots__ = ("spec", "backend", "generation", "component", "queue",
                  "rows_queued", "current", "ready", "shedding", "slo",
-                 "warmed", "inflight")
+                 "warmed", "inflight", "loop", "seqs_queued")
 
     def __init__(self, spec, backend, generation, component):
         self.spec = spec
@@ -153,6 +155,8 @@ class _ModelState:
         self.slo = None
         self.warmed = set()
         self.inflight = {}        # generation -> in-flight batch count
+        self.loop = None          # DecodeLoop for decode specs
+        self.seqs_queued = 0      # decode requests counted in the pool
 
 
 class ModelGateway:
@@ -239,6 +243,18 @@ class ModelGateway:
         component = _hp.unique_component("gateway/%s" % spec.name)
         st = _ModelState(spec, backend, self.registry.generation(spec.name),
                          component)
+        if spec.decode is not None:
+            # Sequence requests bypass the batcher queue entirely: the
+            # model's DecodeLoop owns its device and schedules at step
+            # granularity; the hooks keep them inside the gateway's ONE
+            # admission pool (release) and shed accounting (shed).
+            st.loop = DecodeLoop(
+                spec, backend, st.generation,
+                release=lambda n, depth, _st=st:
+                    self._seq_release(_st, n, depth),
+                shed=lambda seq, reason, _name=spec.name:
+                    _gw_shed.labels(model=_name, reason=reason,
+                                    deadline_class=seq.cls).inc())
         if spec.slo is not None:
             objective, threshold_s = spec.slo
             st.slo = ServiceLevelObjective(
@@ -258,6 +274,8 @@ class ModelGateway:
         if closed:
             self.registry.unregister(spec.name)
             _hp.clear_ready(component)
+            if st.loop is not None:
+                st.loop.close(drain=False)
             if st.slo is not None:
                 with self._burn_lock:
                     self._burn.remove(st.slo.name)
@@ -272,7 +290,11 @@ class ModelGateway:
         """Compile a backend's bucket ladder (minus ``skip``) with the
         same device placement the serving path uses — THE warmup for
         registration and for reload's off-path new-version warmup.
-        Returns the set of warmed buckets."""
+        Returns the set of warmed buckets. Decode backends warm their
+        own ladder (one step executable per page-count, one prefill per
+        prompt-length bucket) instead of the item-shape batches."""
+        if spec.decode is not None:
+            return backend.warm()
         warmed = set()
         for b in spec.policy.buckets:
             if b in skip:
@@ -316,6 +338,10 @@ class ModelGateway:
         if st is None:
             raise KeyError("model %r is not registered" % (name,))
         self.registry.unregister(name)
+        if st.loop is not None:
+            # Queued + in-flight sequences fail through the loop's shed
+            # path; its release hook settles the pool accounting.
+            st.loop.close(drain=False)
         for req in failed:
             if req.future.set_running_or_notify_cancel():
                 req.future.set_exception(
@@ -325,6 +351,7 @@ class ModelGateway:
             with self._burn_lock:
                 self._burn.remove(st.slo.name)
         self._drop_metrics(name)
+        _drop_decode_metrics(name)
         return st.spec
 
     @staticmethod
@@ -399,6 +426,8 @@ class ModelGateway:
         with self._cond:
             states = list(self._models.values())
         for st in states:
+            if st.loop is not None:
+                st.loop.close(drain=drain, timeout=timeout)
             _hp.clear_ready(st.component)
 
     def __enter__(self):
@@ -417,6 +446,9 @@ class ModelGateway:
         self._burn_tick()
         st = self._state(model)
         spec = st.spec
+        if spec.decode is not None:
+            raise ValueError("model %r is a decode model: use "
+                             "submit_sequence()" % (model,))
         arr = data.asnumpy() if isinstance(data, NDArray) \
             else np.array(data, dtype=spec.dtype)
         if tuple(arr.shape[1:]) != spec.item_shape:
@@ -463,6 +495,15 @@ class ModelGateway:
                 raise QueueFullError(
                     "gateway pool full (%d pending, max_queue=%d)"
                     % (self._total, self._max_queue))
+            share_cap = self._share_cap(spec)
+            if share_cap is not None and len(st.queue) >= share_cap:
+                _gw_shed.labels(model=model, reason="queue_full",
+                                deadline_class=cls).inc()
+                raise QueueFullError(
+                    "model %r queue share exhausted (%d queued, "
+                    "queue_share=%.2f of %d)"
+                    % (model, len(st.queue), spec.queue_share,
+                       self._max_queue))
             st.queue.append(req)
             st.rows_queued += rows
             self._total += 1
@@ -480,6 +521,107 @@ class ModelGateway:
         return self.submit(model, data, deadline_class=deadline_class,
                            timeout_ms=timeout_ms).result()
 
+    def _share_cap(self, spec):
+        """Per-model queue bound from ``ModelSpec.queue_share`` (None =
+        only the shared pool bound applies)."""
+        if spec.queue_share is None:
+            return None
+        return max(1, -int(-spec.queue_share * self._max_queue // 1))
+
+    # -- sequence request path (continuous batching) ---------------------------
+
+    def submit_sequence(self, model, prompt, deadline_class=None,
+                        timeout_ms=None, max_tokens=None):
+        """Enqueue one SEQUENCE for a decode model (continuous
+        batching); returns a Future yielding a
+        :class:`~.continuous.SequenceResult`. Admission runs through
+        the same pool, readiness, SLO-shedding, and deadline-class
+        ladder as :meth:`submit` — the deadline covers the WHOLE
+        sequence, so a slow decode sheds mid-flight."""
+        self._burn_tick()
+        st = self._state(model)
+        spec = st.spec
+        if spec.decode is None:
+            raise ValueError("model %r is not a decode model: use "
+                             "submit()" % (model,))
+        cls = deadline_class if deadline_class is not None \
+            else spec.default_class
+        if cls not in spec.class_timeouts:
+            raise ValueError("unknown deadline class %r for model %r "
+                             "(have: %s)" % (cls, model,
+                                             [c for c, _ in spec.classes]))
+        now = time.perf_counter()
+        if timeout_ms is None:
+            timeout_ms = spec.class_timeouts[cls]
+        deadline = now + timeout_ms / 1e3 if timeout_ms is not None \
+            else None
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("gateway is shut down")
+            st2 = self._models.get(model)
+            if st2 is not st:
+                raise KeyError("model %r is not registered" % (model,))
+            if not st.ready:
+                _gw_shed.labels(model=model, reason="unready",
+                                deadline_class=cls).inc()
+                raise ServiceUnavailableError(
+                    "model %r is not ready (warmup in flight)" % model)
+            if st.shedding and cls == spec.lowest_class:
+                _gw_shed.labels(model=model, reason="slo_burn",
+                                deadline_class=cls).inc()
+                raise ServiceUnavailableError(
+                    "model %r is burning its SLO error budget: shedding "
+                    "deadline class %r" % (model, cls))
+            if self._total >= self._max_queue:
+                _gw_shed.labels(model=model, reason="queue_full",
+                                deadline_class=cls).inc()
+                raise QueueFullError(
+                    "gateway pool full (%d pending, max_queue=%d)"
+                    % (self._total, self._max_queue))
+            share_cap = self._share_cap(spec)
+            if share_cap is not None and st.seqs_queued >= share_cap:
+                _gw_shed.labels(model=model, reason="queue_full",
+                                deadline_class=cls).inc()
+                raise QueueFullError(
+                    "model %r queue share exhausted (%d queued, "
+                    "queue_share=%.2f of %d)"
+                    % (model, st.seqs_queued, spec.queue_share,
+                       self._max_queue))
+            self._total += 1
+            st.seqs_queued += 1
+        # The loop's own lock is taken OUTSIDE the gateway lock (the
+        # release hook goes loop-thread -> gateway lock; nesting the
+        # other way here would be an inversion).
+        try:
+            seq = st.loop.submit(prompt, max_tokens=max_tokens,
+                                 deadline=deadline, cls=cls)
+        except Exception:
+            with self._cond:
+                self._total -= 1
+                st.seqs_queued -= 1
+            raise
+        _gw_requests.labels(model=model, deadline_class=cls).inc()
+        _gw_queue.labels(model=model).set(st.loop.pending)
+        return seq.future
+
+    def generate(self, model, prompt, deadline_class=None,
+                 timeout_ms=None, max_tokens=None):
+        """Synchronous :meth:`submit_sequence`; returns the
+        :class:`~.continuous.SequenceResult`."""
+        return self.submit_sequence(
+            model, prompt, deadline_class=deadline_class,
+            timeout_ms=timeout_ms, max_tokens=max_tokens).result()
+
+    def _seq_release(self, st, n, depth):
+        """DecodeLoop release hook: ``n`` sequences left the model's
+        pending queue (admitted into slots, shed, or failed) — return
+        their pool capacity. Called by the loop WITHOUT its lock held."""
+        with self._cond:
+            self._total -= n
+            st.seqs_queued -= n
+            self._cond.notify_all()
+        _gw_queue.labels(model=st.spec.name).set(depth)
+
     # -- hot reload seam (driven by serving.reload) ----------------------------
 
     def swap_backend(self, name, backend, warmed=None, drain_timeout=None):
@@ -496,6 +638,25 @@ class ModelGateway:
         with self._cond:
             st = self._models.get(name)
             if st is None:
+                raise KeyError("model %r is not registered" % (name,))
+        if st.loop is not None:
+            # Decode models: the loop owns the drain (in-flight
+            # SEQUENCES finish on their admit-time generation before
+            # the new backend takes the slots) — zero drops, same
+            # contract at sequence granularity.
+            new_gen = self.registry.bump(name)
+            drained = st.loop.swap_backend(backend, new_gen,
+                                           drain_timeout=drain_timeout)
+            with self._cond:
+                st.backend = backend
+                st.generation = new_gen
+            _trace.instant("serving::swap_commit", model=name,
+                           generation=new_gen)
+            _gw_generation.labels(model=name).set(new_gen)
+            return new_gen, drained
+        with self._cond:
+            st2 = self._models.get(name)
+            if st2 is not st:
                 raise KeyError("model %r is not registered" % (name,))
             old_gen = st.generation
             st.backend = backend
@@ -562,6 +723,8 @@ class ModelGateway:
                 "p50_ms": (lat.quantile(0.50) if lat else 0.0) * 1e3,
                 "p99_ms": (lat.quantile(0.99) if lat else 0.0) * 1e3,
             }
+            if st.loop is not None:
+                out[name]["decode"] = st.loop.stats()
         return out
 
     # -- SLO-coupled shedding --------------------------------------------------
@@ -693,7 +856,9 @@ class ModelGateway:
         had time to serve)."""
         if st.rows_queued >= st.spec.policy.max_batch:
             return now
-        due = st.queue[0].t_submit + self._max_delay
+        delay = self._max_delay if st.spec.max_delay_ms is None \
+            else st.spec.max_delay_ms / 1e3
+        due = st.queue[0].t_submit + delay
         rows = 0
         for req in st.queue:
             if rows + req.rows > st.spec.policy.max_batch:

@@ -89,17 +89,54 @@ class ModelSpec:
     slo : (objective, threshold_s), optional
         Latency SLO over this model's gateway latency series, e.g.
         ``(0.99, 0.250)``; drives SLO-coupled shedding.
+    decode : DecodeConfig or dict, optional
+        Marks a CONTINUOUS-BATCHING sequence model
+        (:mod:`.continuous`): the decode step/prefill functions and the
+        paged-state shape. Mutually exclusive with ``fn``/``checkpoint``
+        (the step IS the model); ``max_batch``/``buckets`` become the
+        decode slot ladder and ``item_shape`` is not required. Requests
+        route through ``gateway.submit_sequence``.
+    max_delay_ms : float, optional
+        Per-model micro-batching window override: this model's queue
+        flushes after at most this long even when the gateway-wide
+        window (``ModelGateway(max_delay_ms=)``) is longer — the
+        latency-class escape hatch. Default None = gateway window.
+    queue_share : float in (0, 1], optional
+        Cap on this model's share of the gateway admission pool: it may
+        queue at most ``ceil(queue_share * max_queue)`` requests, so one
+        hot model cannot fill the whole pool before fair-share kicks
+        in. Default None = bounded only by the pool.
     data_name : checkpoint models' data input name (default "data").
     ctx : device context for backend calls (default device when None).
     """
 
     def __init__(self, name, *, fn=None, params=(), checkpoint=None,
-                 epoch=0, item_shape, dtype="float32", max_batch=32,
+                 epoch=0, item_shape=None, dtype="float32", max_batch=32,
                  buckets=None, weight=1.0, deadline_classes=None,
                  default_timeout_ms=None, quantize=None, mesh_axes=None,
-                 slo=None, data_name="data", ctx=None):
-        if (fn is None) == (checkpoint is None):
-            raise ValueError("pass exactly one of fn= or checkpoint=")
+                 slo=None, decode=None, max_delay_ms=None,
+                 queue_share=None, data_name="data", ctx=None):
+        if decode is not None:
+            if fn is not None or checkpoint is not None:
+                raise ValueError("a decode model's step function rides "
+                                 "decode=; fn=/checkpoint= must be None")
+            if quantize or mesh_axes is not None:
+                raise ValueError("decode= is incompatible with "
+                                 "quantize=/mesh_axes= (wrap the step "
+                                 "function instead)")
+            from .continuous import DecodeConfig
+
+            if isinstance(decode, dict):
+                decode = DecodeConfig(**decode)
+            if not isinstance(decode, DecodeConfig):
+                raise ValueError("decode= must be a DecodeConfig or its "
+                                 "kwargs dict, got %r" % (decode,))
+        else:
+            if (fn is None) == (checkpoint is None):
+                raise ValueError("pass exactly one of fn= or checkpoint=")
+            if item_shape is None:
+                raise ValueError("item_shape is required for batch "
+                                 "(non-decode) models")
         if quantize not in _QUANT_MODES:
             raise ValueError("quantize must be one of %r, got %r"
                              % (_QUANT_MODES, quantize))
@@ -113,9 +150,22 @@ class ModelSpec:
         self.params = list(params)
         self.checkpoint = checkpoint
         self.epoch = int(epoch)
-        self.item_shape = tuple(item_shape)
+        self.decode = decode
+        self.item_shape = tuple(item_shape) if item_shape is not None \
+            else None
         self.dtype = np.dtype(dtype)
         self.policy = BucketPolicy(max_batch=max_batch, buckets=buckets)
+        self.max_delay_ms = None if max_delay_ms is None \
+            else float(max_delay_ms)
+        if self.max_delay_ms is not None and self.max_delay_ms < 0:
+            raise ValueError("max_delay_ms must be >= 0, got %r"
+                             % (max_delay_ms,))
+        self.queue_share = None if queue_share is None \
+            else float(queue_share)
+        if self.queue_share is not None \
+                and not 0.0 < self.queue_share <= 1.0:
+            raise ValueError("queue_share must be in (0, 1], got %r"
+                             % (queue_share,))
         self.weight = float(weight)
         if self.weight <= 0:
             raise ValueError("weight must be > 0, got %r" % (weight,))
@@ -149,7 +199,19 @@ class ModelSpec:
         fn models, ``checkpoint=``/``epoch=`` for checkpoint models).
         The returned object is ``__call__(batch NDArray) -> NDArray``
         (or tuple) with a ``compile_count`` property, and owns its own
-        executable cache — swapping backends swaps every executable."""
+        executable cache — swapping backends swaps every executable.
+        Decode specs build a :class:`.continuous._DecodeBackend` (the
+        paged state buffers plus step/prefill executables) instead."""
+        if self.decode is not None:
+            if checkpoint is not None or epoch is not None:
+                raise ValueError("model %r is a decode model: reload it "
+                                 "with params=, not checkpoint="
+                                 % self.name)
+            from .continuous import _DecodeBackend
+
+            pvals = self.params if params is None else list(params)
+            return _DecodeBackend(self.decode, pvals, name=self.name,
+                                  policy=self.policy, ctx=self.ctx)
         if self.fn is not None:
             if checkpoint is not None or epoch is not None:
                 raise ValueError("model %r is an fn model: reload it "
@@ -176,8 +238,10 @@ class ModelSpec:
 
     def describe(self):
         return {
-            "kind": "fn" if self.fn is not None else "checkpoint",
-            "item_shape": list(self.item_shape),
+            "kind": "decode" if self.decode is not None
+            else "fn" if self.fn is not None else "checkpoint",
+            "item_shape": list(self.item_shape)
+            if self.item_shape is not None else None,
             "dtype": str(self.dtype),
             "buckets": list(self.policy.buckets),
             "weight": self.weight,
@@ -185,6 +249,10 @@ class ModelSpec:
             "quantize": self.quantize,
             "mesh_axes": self.mesh_axes,
             "slo": list(self.slo) if self.slo else None,
+            "decode": self.decode.describe()
+            if self.decode is not None else None,
+            "max_delay_ms": self.max_delay_ms,
+            "queue_share": self.queue_share,
         }
 
 

@@ -273,8 +273,12 @@ class LocalBus:
                     oldest = next(iter(self._cc))
                     self._cc_bytes -= len(self._cc.pop(oldest)[1])
 
-    def cc_probe(self, keys):
+    def cc_probe(self, keys=None):
+        # keys=None enumerates every held key (whole-store prefetch),
+        # mirroring the kvstore server's cc_probe contract.
         with self._lock:
+            if keys is None:
+                return list(self._cc)
             return [k for k in keys if k in self._cc]
 
     def cc_pull(self, key):
@@ -312,7 +316,7 @@ class _LocalEndpoint:
     def cc_push(self, key, meta, blob):
         self._bus.cc_push(key, meta, blob)
 
-    def cc_probe(self, keys):
+    def cc_probe(self, keys=None):
         return self._bus.cc_probe(keys)
 
     def cc_pull(self, key):

@@ -440,6 +440,47 @@ def test_localbus_rank1_pulls_rank0_entries(tmp_path):
     assert cf2.num_compiles == 0 and cf2.num_hits == 1
 
 
+def test_attach_kvstore_prefetch_warms_joiner_store(tmp_path):
+    """Pod prefetch: attach_kvstore runs ONE cc_probe(None) enumeration
+    round and commits every missing entry to the joiner's disk store —
+    so a later start hits disk with no pod traffic at all."""
+    jnp = _jnp()
+    bus = LocalBus(num_workers=2)
+
+    def f(x):
+        return jnp.sqrt(x + 3)
+
+    def g(x):
+        return jnp.cos(x) * 2
+
+    x = jnp.ones((8,))
+    # Rank 0 compiles + publishes two entries.
+    cc.configure(str(tmp_path / "rank0"))
+    cc.set_distributor(CacheDistributor(bus.endpoint(0)))
+    cc.cached_compile(f, "pf_a")(x)
+    cc.cached_compile(g, "pf_b")(x)
+    assert len(bus._cc) == 2
+    # cc_probe(None) enumerates every held key in one round.
+    assert sorted(bus.cc_probe(None)) == sorted(bus._cc)
+    # Rank 1 joins with an EMPTY store: attach prefetches both entries
+    # onto disk before any trace happens.
+    cc.reset()
+    cc.configure(str(tmp_path / "rank1"))
+    pre0 = _counter("mx_compile_cache_prefetched_total")
+    dist = cc.attach_kvstore(bus.endpoint(1))
+    assert dist is not None
+    assert _counter("mx_compile_cache_prefetched_total") == pre0 + 2
+    assert len(cc.active_store().keys()) == 2
+    # Disk-only from here: drop the distributor, both sites still hit.
+    cc.set_distributor(None)
+    cf = cc.cached_compile(f, "pf_a")
+    cf(x)
+    assert cf.num_compiles == 0 and cf.num_hits == 1
+    # Re-attach is idempotent: everything already local, nothing pulled.
+    cc.attach_kvstore(bus.endpoint(1))
+    assert _counter("mx_compile_cache_prefetched_total") == pre0 + 2
+
+
 def test_shared_filesystem_mode_skips_kvstore_channel(tmp_path,
                                                       monkeypatch):
     """MXNET_COMPILE_CACHE_SHARED=1 (every rank's cache dir is one

@@ -666,6 +666,116 @@ class TestTracePropagation:
 
 
 # ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+class TestRetraceHazard:
+    def test_if_on_traced_arg_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.compile import maybe_cached_jit
+            def step(state, tokens):
+                if tokens > 0:
+                    return state + 1
+                return state
+            _step = maybe_cached_jit(step, "decode_step")
+            """, checks=["retrace-hazard"])
+        assert checks_of(res) == ["retrace-hazard"]
+        assert "'tokens'" in res.findings[0].message
+
+    def test_nested_closure_target_fires(self, tmp_path):
+        # The dominant repo idiom: the pure fn is a closure built in
+        # __init__ and handed to the jit seam by name.
+        res = lint(tmp_path, """
+            from mxnet_tpu.compile import maybe_cached_jit
+            class Backend:
+                def __init__(self, cfg):
+                    def step_pure(params, x):
+                        if x.sum() > 0:
+                            return x * params
+                        return x
+                    self._step = maybe_cached_jit(step_pure, "s")
+            """, checks=["retrace-hazard"])
+        assert checks_of(res) == ["retrace-hazard"]
+
+    def test_safe_predicates_quiet(self, tmp_path):
+        # is-None pytree dispatch, isinstance/len, and static metadata
+        # attributes are part of the trace SIGNATURE, not traced values.
+        res = lint(tmp_path, """
+            import jax
+            def step(state, x, aux):
+                if aux is None:
+                    x = x + 1
+                if isinstance(state, tuple) and len(state) > 1:
+                    x = x * 2
+                if x.ndim == 2 and x.shape[0] > 4:
+                    x = x.sum(axis=0)
+                if x.dtype == "float32" and not x.weak_type:
+                    x = x * 3
+                return state, x
+            _f = jax.jit(step)
+            """, checks=["retrace-hazard"])
+        assert res.findings == []
+
+    def test_static_argnames_exempt(self, tmp_path):
+        res = lint(tmp_path, """
+            import jax
+            def step(x, mode):
+                if mode == "train":
+                    return x * 2
+                return x
+            _f = jax.jit(step, static_argnames=("mode",))
+            """, checks=["retrace-hazard"])
+        assert res.findings == []
+
+    def test_jit_decorator_fires(self, tmp_path):
+        res = lint(tmp_path, """
+            import jax
+            from functools import partial
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+
+            @partial(jax.jit, static_argnums=(1,))
+            def g(x, n):
+                if n > 2:       # static by contract: quiet
+                    x = x + 1
+                return x
+            """, checks=["retrace-hazard"])
+        assert checks_of(res) == ["retrace-hazard"]
+        assert res.findings[0].message.count("'x'") == 1
+
+    def test_closure_and_free_names_quiet(self, tmp_path):
+        # Branching on config captured by closure (not a traced arg)
+        # is trace-time specialization by design.
+        res = lint(tmp_path, """
+            from mxnet_tpu.compile import maybe_cached_jit
+            def build(cfg):
+                def step(state, x):
+                    if cfg.single_state:
+                        return state + x
+                    return tuple(s + x for s in state)
+                return maybe_cached_jit(step, "site")
+            """, checks=["retrace-hazard"])
+        assert res.findings == []
+
+    def test_justified_suppression_honored(self, tmp_path):
+        res = lint(tmp_path, """
+            from mxnet_tpu.compile import maybe_cached_jit
+            def step(x):
+                # mxlint: disable=retrace-hazard -- x is always a
+                # concrete host scalar at this seam, two traces total
+                if x > 0:
+                    return x
+                return -x
+            _f = maybe_cached_jit(step, "site")
+            """, checks=["retrace-hazard"])
+        assert res.findings == [] and res.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,400 @@
+"""mxnet_tpu.serving.continuous — continuous batching (ISSUE 19
+tentpole): per-iteration slot scheduling, paged per-slot state, and the
+zero-steady-state-retrace contract; plus the gateway seams (admission
+pool + queue-share, deadline shedding mid-decode, hot reload draining
+in-flight sequences on the old generation) and the per-model
+`max_delay_ms` batcher override. Model names are minted per test so the
+process-global metric families never blend across tests."""
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+from mxnet_tpu.serving import (DeadlineExceededError, DecodeConfig,
+                               DecodeLoop, ModelGateway, ModelSpec,
+                               PagedSlotAllocator, QueueFullError,
+                               SequenceResult, ServiceUnavailableError,
+                               hot_swap)
+
+_names = itertools.count()
+
+
+def _name(base="dm"):
+    return "%s%d" % (base, next(_names))
+
+
+H = 4            # per-slot state width
+
+
+def _w(fill=1.0):
+    return mx.nd.array(np.full((H,), fill, np.float32))
+
+
+def _step(w, state, tokens, pos):
+    """Counter decoder: state accumulates w, next token = last + 1 —
+    fully deterministic, so expected outputs are computable host-side."""
+    return state + w, tokens + 1
+
+
+def _cfg(**kw):
+    kw.setdefault("state_shape", (H,))
+    kw.setdefault("page_slots", 4)
+    kw.setdefault("max_tokens", 4)
+    return DecodeConfig(_step, **kw)
+
+
+def _spec(name, w=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("decode", _cfg())
+    return ModelSpec(name, params=[w if w is not None else _w()], **kw)
+
+
+def _loop(name=None, w=None, spec_kw=None, **kw):
+    spec = _spec(name or _name(), w=w, **(spec_kw or {}))
+    return DecodeLoop(spec, spec.build_backend(), **kw)
+
+
+def _expect(prompt, n):
+    """Tokens the counter decoder emits for `prompt`, n tokens total."""
+    last = int(np.asarray(prompt).reshape(-1)[-1])
+    return [last + 1 + i for i in range(n)]
+
+
+# -- PagedSlotAllocator ------------------------------------------------------
+
+class TestPagedSlotAllocator:
+    def test_lowest_first_and_reuse(self):
+        a = PagedSlotAllocator(8, 4)
+        assert [a.alloc() for _ in range(4)] == [0, 1, 2, 3]
+        a.free(1)
+        a.free(0)
+        # Freed slots come back lowest-first: occupancy stays
+        # prefix-compact so the stepped page count tracks load DOWN.
+        assert a.alloc() == 0
+        assert a.alloc() == 1
+        assert a.occupancy == 4 and a.high_water == 4
+
+    def test_exhaustion_returns_none(self):
+        a = PagedSlotAllocator(2, 4)
+        assert a.alloc() == 0 and a.alloc() == 1
+        assert a.alloc() is None
+        a.free(0)
+        assert a.alloc() == 0
+
+    def test_double_free_raises(self):
+        a = PagedSlotAllocator(4, 2)
+        s = a.alloc()
+        a.free(s)
+        with pytest.raises(ValueError):
+            a.free(s)
+        with pytest.raises(ValueError):
+            a.free(99)
+
+    def test_pages_and_high_water(self):
+        a = PagedSlotAllocator(8, 4)
+        assert a.num_pages == 2
+        assert a.high_water == 0
+        for _ in range(5):
+            a.alloc()
+        assert a.high_water == 5
+        assert a.pages_for(a.high_water) == 2
+        for s in (4, 3, 2):
+            a.free(s)
+        assert a.high_water == 2 and a.pages_for(a.high_water) == 1
+
+
+# -- config / spec validation ------------------------------------------------
+
+def test_decode_config_validation():
+    with pytest.raises(ValueError):
+        DecodeConfig("nope", state_shape=(4,))
+    with pytest.raises(ValueError):
+        DecodeConfig(_step, state_shape=())
+    with pytest.raises(ValueError):
+        DecodeConfig(_step, state_shape=(4,), page_slots=0)
+    with pytest.raises(ValueError):
+        DecodeConfig(_step, state_shape=(4,), max_tokens=0)
+    with pytest.raises(ValueError):
+        DecodeConfig(_step, state_shape=(4,), init="nope")
+    d = DecodeConfig(_step, state_shape=(4,), page_slots=2,
+                     stop_token=0)
+    assert d.describe()["page_slots"] == 2
+    assert d.single_state
+
+
+def test_decode_spec_validation():
+    with pytest.raises(ValueError):        # decode excludes fn=
+        ModelSpec("x", fn=_step, decode=_cfg(), params=[_w()])
+    with pytest.raises(ValueError):        # ... and checkpoint=
+        ModelSpec("x", checkpoint="p", decode=_cfg())
+    with pytest.raises(ValueError):        # ... and quantize=
+        ModelSpec("x", decode=_cfg(), params=[_w()], quantize="int8")
+    with pytest.raises(ValueError):        # ... and mesh_axes=
+        ModelSpec("x", decode=_cfg(), params=[_w()],
+                  mesh_axes={"tp": 2})
+    with pytest.raises(ValueError):
+        ModelSpec("x", fn=_step, params=[_w()], item_shape=(4,),
+                  max_delay_ms=-1)
+    with pytest.raises(ValueError):
+        ModelSpec("x", fn=_step, params=[_w()], item_shape=(4,),
+                  queue_share=0)
+    with pytest.raises(ValueError):
+        ModelSpec("x", fn=_step, params=[_w()], item_shape=(4,),
+                  queue_share=1.5)
+    # dict coercion + describe round-trip.
+    sp = ModelSpec(_name(), params=[_w()], max_batch=4,
+                   decode={"step": _step, "state_shape": (H,)},
+                   max_delay_ms=2.5, queue_share=0.5)
+    d = sp.describe()
+    assert d["kind"] == "decode"
+    assert d["max_delay_ms"] == 2.5 and d["queue_share"] == 0.5
+    assert d["decode"]["state_shape"] == [[H]]
+
+
+# -- standalone loop ---------------------------------------------------------
+
+def test_loop_generates_expected_tokens():
+    loop = _loop()
+    try:
+        seqs = [loop.submit([3, 5], max_tokens=3),
+                loop.submit([10], max_tokens=5)]
+        r0 = seqs[0].future.result(timeout=30)
+        r1 = seqs[1].future.result(timeout=30)
+        assert isinstance(r0, SequenceResult)
+        assert r0.tokens == _expect([3, 5], 3)
+        assert r1.tokens == _expect([10], 5)
+        assert r0.generation == 1 and r0.ttft_s >= 0
+    finally:
+        loop.close()
+
+
+def test_stop_token_terminates_early():
+    loop = _loop(spec_kw={"decode": _cfg(stop_token=7, max_tokens=50)})
+    try:
+        # Counter decoder from 4 hits 7 after 3 tokens (5, 6, 7).
+        r = loop.submit([4]).future.result(timeout=30)
+        assert r.tokens == [5, 6, 7]
+    finally:
+        loop.close()
+
+
+def test_slot_churn_zero_retrace():
+    """THE contract: after warm(), admit/retire churn at every step
+    (mixed lengths, mixed prompts, occupancy crossing page boundaries)
+    adds ZERO compiles — page-count canonicalization means slot churn
+    is data, never shape."""
+    spec = _spec(_name())
+    backend = spec.build_backend()
+    warmed = backend.warm()
+    assert warmed == set(spec.policy.buckets)
+    base = backend.compile_count
+    loop = DecodeLoop(spec, backend)
+    try:
+        rng = np.random.RandomState(7)
+        seqs = [loop.submit(rng.randint(1, 100, size=rng.randint(1, 4)),
+                            max_tokens=int(rng.randint(1, 7)))
+                for _ in range(32)]
+        for s in seqs:
+            r = s.future.result(timeout=60)
+            assert r.tokens == _expect(s.prompt, s.max_tokens)
+        steps = loop.stats()
+        assert steps["compile_count"] == base, \
+            "slot churn retraced: %d -> %d compiles" \
+            % (base, steps["compile_count"])
+        assert loop.occupancy == 0 and loop.pending == 0
+    finally:
+        loop.close()
+
+
+def test_exhaustion_queues_not_drops():
+    """More sequences than slots: the surplus WAITS in the pending
+    queue and every one completes — exhaustion is backpressure, never
+    a drop."""
+    loop = _loop(spec_kw={"max_batch": 2,
+                          "decode": _cfg(page_slots=2, max_tokens=3)})
+    try:
+        seqs = [loop.submit([i], max_tokens=3) for i in range(6)]
+        assert loop.alloc.max_slots == 2
+        for i, s in enumerate(seqs):
+            r = s.future.result(timeout=30)
+            assert r.tokens == _expect([i], 3)
+    finally:
+        loop.close()
+
+
+def test_deadline_mid_decode_sheds_and_frees_slot():
+    shed = []
+    loop = _loop(shed=lambda seq, reason: shed.append(reason))
+    try:
+        # An effectively endless sequence with a near-instant deadline:
+        # the mid-decode check retires the slot and sheds.
+        s = loop.submit([1], max_tokens=100000,
+                        deadline=time.perf_counter() + 0.05)
+        with pytest.raises(DeadlineExceededError):
+            s.future.result(timeout=30)
+        assert "deadline" in shed
+        # The slot came back: a healthy sequence serves right after.
+        r = loop.submit([2], max_tokens=2).future.result(timeout=30)
+        assert r.tokens == _expect([2], 2)
+        assert loop.occupancy == 0
+    finally:
+        loop.close()
+
+
+def test_expired_in_queue_sheds_without_slot():
+    loop = _loop()
+    try:
+        s = loop.submit([1], max_tokens=5,
+                        deadline=time.perf_counter() - 1.0)
+        with pytest.raises(DeadlineExceededError):
+            s.future.result(timeout=30)
+    finally:
+        loop.close()
+
+
+def test_close_fails_pending_and_active():
+    loop = _loop()
+    s = loop.submit([1], max_tokens=10 ** 6)
+    time.sleep(0.05)
+    loop.close(drain=False)
+    with pytest.raises(ServiceUnavailableError):
+        s.future.result(timeout=30)
+    with pytest.raises(ServiceUnavailableError):
+        loop.submit([2])
+
+
+def test_swap_backend_drains_in_flight():
+    spec = _spec(_name())
+    loop = DecodeLoop(spec, spec.build_backend())
+    try:
+        a = loop.submit([1], max_tokens=600)
+        time.sleep(0.02)
+        new_backend = spec.build_backend(params=[_w(2.0)])
+        drained = loop.swap_backend(new_backend, 2, drain_timeout=60)
+        assert drained
+        ra = a.future.result(timeout=30)
+        assert ra.generation == 1 and len(ra.tokens) == 600
+        rb = loop.submit([5], max_tokens=2).future.result(timeout=30)
+        assert rb.generation == 2
+        assert loop.stats()["generation"] == 2
+    finally:
+        loop.close()
+
+
+# -- gateway integration -----------------------------------------------------
+
+def test_gateway_generate_and_stats():
+    gw = ModelGateway()
+    name = _name()
+    try:
+        gw.register(_spec(name))
+        r = gw.generate(name, [2, 9], max_tokens=3)
+        assert r.tokens == _expect([2, 9], 3)
+        assert r.model == name and r.generation == 1
+        st = gw.stats()[name]
+        assert st["decode"]["slots"] == 8
+        assert st["decode"]["occupancy"] == 0
+        assert st["decode"]["compile_count"] >= 1
+        # Wrong-kind routing is an error both ways.
+        with pytest.raises(ValueError):
+            gw.submit(name, mx.nd.array(np.zeros((1, 4), np.float32)))
+        fname = _name("fn")
+        gw.register(ModelSpec(
+            fname, fn=lambda w, x: mx.nd.dot(x, w),
+            params=[mx.nd.array(np.zeros((4, 2), np.float32))],
+            item_shape=(4,), max_batch=4))
+        with pytest.raises(ValueError):
+            gw.submit_sequence(fname, [1])
+    finally:
+        gw.shutdown()
+
+
+def test_gateway_hot_reload_drains_old_generation():
+    """In-flight sequences finish on their admit-time generation; the
+    swap commits only after the old generation drains; post-swap
+    sequences carry the new one."""
+    gw = ModelGateway()
+    name = _name()
+    try:
+        gw.register(_spec(name))
+        fut = gw.submit_sequence(name, [1], max_tokens=800)
+        time.sleep(0.02)
+        assert not fut.done(), "sequence finished before the swap began"
+        gen = hot_swap(gw, name, params=[_w(3.0)])
+        assert gen == 2
+        ra = fut.result(timeout=30)
+        assert ra.generation == 1 and len(ra.tokens) == 800
+        rb = gw.generate(name, [1], max_tokens=2)
+        assert rb.generation == 2
+    finally:
+        gw.shutdown()
+
+
+def test_gateway_queue_share_caps_decode_queue():
+    gw = ModelGateway(max_queue=8)
+    name = _name()
+    try:
+        gw.register(_spec(name, queue_share=0.25, max_batch=1,
+                          decode=_cfg(page_slots=1, max_tokens=10 ** 6)))
+        # One endless sequence occupies the single slot...
+        holder = gw.submit_sequence(name, [1])
+        deadline = time.monotonic() + 10
+        while gw.stats()[name]["decode"]["occupancy"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        # ...then 0.25 * max_queue = 2 sequences may QUEUE; the third
+        # sheds at this model's door, far below the global pool bound.
+        queued = [gw.submit_sequence(name, [2]) for _ in range(2)]
+        with pytest.raises(QueueFullError) as exc:
+            gw.submit_sequence(name, [3])
+        assert "queue share" in str(exc.value)
+        holder.cancel()
+        for q in queued:
+            q.cancel()
+    finally:
+        gw.shutdown(drain=False)
+
+
+def test_gateway_per_model_max_delay_override():
+    """A latency-class model flushes its partial batch at ITS delay,
+    not the gateway-wide one."""
+    gw = ModelGateway(max_delay_ms=400.0)
+    fast = _name("fast")
+    try:
+        gw.register(ModelSpec(
+            fast, fn=lambda w, x: mx.nd.dot(x, w),
+            params=[mx.nd.array(np.eye(4, dtype=np.float32))],
+            item_shape=(4,), max_batch=8, max_delay_ms=2.0))
+        x = mx.nd.array(np.ones((1, 4), np.float32))
+        gw.predict(fast, x)                 # warm the bucket
+        t0 = time.perf_counter()
+        gw.predict(fast, x)
+        took = time.perf_counter() - t0
+        assert took < 0.25, \
+            "max_delay_ms=2 override ignored: partial batch waited " \
+            "%.0f ms (gateway default is 400)" % (took * 1e3)
+    finally:
+        gw.shutdown()
+
+
+def test_decode_metrics_present_and_dropped_on_unregister():
+    from mxnet_tpu.telemetry import metrics as tm
+
+    gw = ModelGateway()
+    name = _name()
+    try:
+        gw.register(_spec(name))
+        gw.generate(name, [1], max_tokens=2)
+        fam = tm.REGISTRY.get("mx_decode_tokens_total")
+        assert fam.labels(model=name).value >= 2
+        assert tm.REGISTRY.get(
+            "mx_decode_steps_total").labels(model=name).value >= 1
+        gw.unregister(name)
+        assert all(v[0] != name for v, _ in fam.collect()), \
+            "unregister left decode series behind"
+    finally:
+        gw.shutdown()

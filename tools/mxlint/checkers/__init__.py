@@ -7,6 +7,7 @@ documents the how-to).
 """
 from .envknobs import EnvKnobChecker
 from .locks import LockChecker
+from .retrace import RetraceHazardChecker
 from .signals import SignalChecker
 from .staleknobs import StaleKnobChecker
 from .telemetry_names import TelemetryNameChecker
@@ -24,6 +25,7 @@ ALL_CHECKERS = (
     ThreadChecker,
     TelemetryNameChecker,
     TracePropagationChecker,
+    RetraceHazardChecker,
 )
 
 # Selectable names (--check=...): a checker may emit secondary finding
@@ -38,6 +40,7 @@ CHECKS = {
     "thread-lifecycle": ThreadChecker,
     "telemetry-naming": TelemetryNameChecker,
     "trace-propagation": TracePropagationChecker,
+    "retrace-hazard": RetraceHazardChecker,
 }
 
 __all__ = ["ALL_CHECKERS", "CHECKS"]
