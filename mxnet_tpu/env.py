@@ -117,6 +117,21 @@ CATALOGUE = [
          "capture root for POST /debug/xprof (jax.profiler.trace "
          "output); default: <recorder dir>/xprof when a FlightRecorder "
          "is attached to the health plane", False),
+    Knob("MXNET_GOODPUT_DIR", str, "", "telemetry/goodput.py",
+         "goodput ledger root: goodput.rank<R>.json is committed here "
+         "atomically and resumed after a restart; empty = in-memory "
+         "accounting only (no durability, no restart_replay)", False),
+    Knob("MXNET_GOODPUT_INTERVAL_S", float, 30.0,
+         "telemetry/goodput.py",
+         "goodput ledger tick cadence: fold + durable commit at most "
+         "this often (0 = every tick; crash tests use that for "
+         "step-accurate replay watermarks)", False),
+    Knob("MXNET_GOODPUT_CLOSURE_PCT", float, 2.0,
+         "telemetry/goodput.py",
+         "goodput closure tolerance: snapshots whose categories "
+         "overcount wall-clock by more than this percentage warn and "
+         "report closure_ok=false (overcount = double-booked seconds; "
+         "undercount is impossible — idle absorbs it)", False),
     Knob("MXNET_DATA_MAX_WORKERS", int, 16, "data/autoscale.py",
          "decode-pool autoscaling ceiling: DecodeAutoscaler never grows "
          "a pool past this many workers", False),

@@ -64,6 +64,11 @@ __all__ = ["DecodeConfig", "PagedSlotAllocator", "DecodeLoop",
 _dc_occupancy = _tm.REGISTRY.gauge(
     "mx_decode_slot_occupancy",
     "Occupied decode batch slots per model", labels=("model",))
+_dc_slots = _tm.REGISTRY.gauge(
+    "mx_decode_slots",
+    "Total decode batch slots per model (the occupancy denominator — "
+    "goodput's decode slot-idle fraction divides these two)",
+    labels=("model",))
 _dc_tokens = _tm.REGISTRY.counter(
     "mx_decode_tokens_total",
     "Generated tokens per model (continuous batching)",
@@ -81,7 +86,8 @@ _logger = _log.get_logger("mxnet_tpu.serving")
 
 def drop_metrics(name):
     """Remove a model's labeled decode series (gateway ``unregister``)."""
-    for fam in (_dc_occupancy, _dc_tokens, _dc_steps, _dc_ttft):
+    for fam in (_dc_occupancy, _dc_slots, _dc_tokens, _dc_steps,
+                _dc_ttft):
         for values, _ in fam.collect():
             if values[0] == name:
                 fam.remove(**dict(zip(fam.labelnames, values)))
@@ -504,6 +510,7 @@ class DecodeLoop:
         self._step_counter = _dc_steps.labels(model=spec.name)
         self._ttft = _dc_ttft.labels(model=spec.name)
         self._occ_gauge.set(0)
+        _dc_slots.labels(model=spec.name).set(self.alloc.max_slots)
         if start:
             self.start()
 

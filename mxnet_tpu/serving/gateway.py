@@ -85,7 +85,7 @@ _gw_latency = _tm.REGISTRY.histogram(
 _gw_shed = _tm.REGISTRY.counter(
     "mx_serving_gateway_shed_total",
     "Requests shed at the gateway: reason=queue_full|deadline|unready|"
-    "slo_burn", labels=("model", "reason", "deadline_class"))
+    "slo_burn|unregister", labels=("model", "reason", "deadline_class"))
 _gw_queue = _tm.REGISTRY.gauge(
     "mx_serving_gateway_queue_depth",
     "Queued requests per model", labels=("model",))
@@ -97,6 +97,17 @@ _gw_shedding = _tm.REGISTRY.gauge(
     "mx_serving_gateway_slo_shedding",
     "1 while a model's SLO burn rate sheds its lowest deadline class",
     labels=("model",))
+# Label-less on purpose: unregister drops the model's labeled series,
+# and the drain outcome must survive that (goodput's serving analog
+# reads these after the model is gone).
+_gw_unreg_drained = _tm.REGISTRY.counter(
+    "mx_gateway_unregister_drained_total",
+    "Queued/in-flight requests served during drain-aware unregister "
+    "before the backend was dropped")
+_gw_unreg_shed = _tm.REGISTRY.counter(
+    "mx_gateway_unregister_shed_total",
+    "Requests failed by unregister after the drain timeout (or with "
+    "drain=False) — gateway badput in the goodput ledger")
 
 _logger = _log.get_logger("mxnet_tpu.serving")
 
@@ -140,7 +151,7 @@ class _GwRequest:
 class _ModelState:
     __slots__ = ("spec", "backend", "generation", "component", "queue",
                  "rows_queued", "current", "ready", "shedding", "slo",
-                 "warmed", "inflight", "loop", "seqs_queued")
+                 "warmed", "inflight", "loop", "seqs_queued", "draining")
 
     def __init__(self, spec, backend, generation, component):
         self.spec = spec
@@ -157,6 +168,7 @@ class _ModelState:
         self.inflight = {}        # generation -> in-flight batch count
         self.loop = None          # DecodeLoop for decode specs
         self.seqs_queued = 0      # decode requests counted in the pool
+        self.draining = False     # unregister drain: no new admissions
 
 
 class ModelGateway:
@@ -323,29 +335,71 @@ class ModelGateway:
             self._cond.notify_all()
         return self
 
-    def unregister(self, name):
-        """Drop a model: queued requests fail, its readiness slot is
-        RELEASED (no permanently not-ready ghost in ``/readyz``), its
-        SLO leaves the burn monitor, and its labeled series leave the
-        registry families."""
+    def unregister(self, name, drain=True, drain_timeout=None):
+        """Drop a model — after serving what it already accepted. With
+        ``drain`` (default) new admissions stop immediately, but the
+        worker keeps dispatching the model's queued requests until the
+        queue and its in-flight batches empty, bounded by
+        ``drain_timeout`` (default ``MXNET_GATEWAY_DRAIN_TIMEOUT_S``);
+        served work counts on ``mx_gateway_unregister_drained_total``.
+        Whatever the timeout strands fails with
+        :class:`ServiceUnavailableError` and is shed with
+        ``reason="unregister"`` — gateway badput in the goodput ledger.
+        Either way the readiness slot is RELEASED (no permanently
+        not-ready ghost in ``/readyz``), the SLO leaves the burn
+        monitor, and the model's labeled series leave the registry
+        families."""
+        from .. import env as _env
+
+        if drain_timeout is None:
+            drain_timeout = _env.get("MXNET_GATEWAY_DRAIN_TIMEOUT_S")
+        drained = 0
         with self._cond:
-            st = self._models.pop(name, None)
-            if st is not None:
-                self._total -= len(st.queue)
-                failed = list(st.queue)
-                st.queue.clear()
-                st.rows_queued = 0
-        if st is None:
-            raise KeyError("model %r is not registered" % (name,))
+            st = self._models.get(name)
+            if st is None:
+                raise KeyError("model %r is not registered" % (name,))
+            st.draining = True
+            target = len(st.queue)
+            # Only a live, unpaused worker can serve the queue; without
+            # one the wait below could never make progress.
+            can_drain = (drain and self._running and not self._closed
+                         and not self._paused
+                         and self._thread is not None and st.ready)
+            if can_drain:
+                deadline = time.monotonic() + float(drain_timeout)
+                while st.queue or st.inflight:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(min(0.1, remaining))
+            # Requests no longer queued were picked for dispatch —
+            # their futures resolve through the batch path even if a
+            # straggler batch is still in flight at the timeout.
+            drained = target - len(st.queue)
+            self._models.pop(name, None)
+            self._total -= len(st.queue)
+            failed = list(st.queue)
+            st.queue.clear()
+            st.rows_queued = 0
         self.registry.unregister(name)
+        seq_drained = 0
         if st.loop is not None:
-            # Queued + in-flight sequences fail through the loop's shed
-            # path; its release hook settles the pool accounting.
-            st.loop.close(drain=False)
+            if drain:
+                # In-flight sequences finish on the loop's own drain;
+                # pending ones fail through its shed path, and the
+                # release hook settles the pool accounting.
+                seq_drained = st.loop.occupancy
+            st.loop.close(drain=drain, timeout=float(drain_timeout))
         for req in failed:
             if req.future.set_running_or_notify_cancel():
                 req.future.set_exception(
                     ServiceUnavailableError("model %r unregistered" % name))
+            _gw_shed.labels(model=name, reason="unregister",
+                            deadline_class=req.cls).inc()
+        if drained + seq_drained:
+            _gw_unreg_drained.inc(drained + seq_drained)
+        if failed:
+            _gw_unreg_shed.inc(len(failed))
         _hp.clear_ready(st.component)
         if st.slo is not None:
             with self._burn_lock:
@@ -477,6 +531,12 @@ class ModelGateway:
             st2 = self._models.get(model)
             if st2 is not st:
                 raise KeyError("model %r is not registered" % (model,))
+            if st.draining:
+                _gw_shed.labels(model=model, reason="unregister",
+                                deadline_class=cls).inc()
+                raise ServiceUnavailableError(
+                    "model %r is draining for unregister — no new "
+                    "admissions" % model)
             if not st.ready:
                 _gw_shed.labels(model=model, reason="unready",
                                 deadline_class=cls).inc()
@@ -561,6 +621,12 @@ class ModelGateway:
             st2 = self._models.get(model)
             if st2 is not st:
                 raise KeyError("model %r is not registered" % (model,))
+            if st.draining:
+                _gw_shed.labels(model=model, reason="unregister",
+                                deadline_class=cls).inc()
+                raise ServiceUnavailableError(
+                    "model %r is draining for unregister — no new "
+                    "admissions" % model)
             if not st.ready:
                 _gw_shed.labels(model=model, reason="unready",
                                 deadline_class=cls).inc()

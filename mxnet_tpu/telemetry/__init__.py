@@ -112,6 +112,19 @@ wall-clock go" on a HEALTHY pod:
 * :mod:`.remote_write` — the Prometheus remote-write wire format
   (pure-python protobuf ``WriteRequest`` + snappy framing) as
   ``PushExporter(wire_format="remote_write")``.
+
+The goodput ledger (ISSUE 20) folds all of the above into the run-level
+answer — "how much of the wall-clock was useful work":
+
+* :mod:`.goodput` — :class:`GoodputLedger`: a mutually-exclusive,
+  collectively-exhaustive goodput/badput taxonomy (device_compute vs.
+  compile / input_stall / h2d / exposed_comm / checkpoint /
+  restart_replay / hang_recovery / idle / other) whose categories sum
+  to wall-clock within a closure tolerance; durable per-rank
+  ``goodput.rank<R>.json`` (atomic commits, resumed after a crash with
+  replayed steps booked as ``restart_replay``), fleet-aggregated
+  ``mx_goodput_seconds_total{category}`` counters, ``GET
+  /debug/goodput``, bundle sections, and ``tools/goodput_report.py``.
 """
 from __future__ import annotations
 
@@ -129,6 +142,7 @@ from . import numerics
 from . import healthplane
 from . import profiling
 from . import attribution
+from . import goodput
 from . import remote_write
 from .metrics import (Registry, REGISTRY, counter, gauge, histogram,
                       render_prometheus, start_http_server,
@@ -144,11 +158,13 @@ from .memstats import DeviceMemoryMonitor
 from .healthplane import HealthPlane, DiagCollector
 from .profiling import ContinuousProfiler
 from .attribution import StepAttribution
+from .goodput import GoodputLedger
 
 __all__ = ["metrics", "xtrace", "trace", "aggregate", "export",
            "flamegraph",
            "slo", "memstats", "watchdog", "recorder", "numerics",
-           "healthplane", "profiling", "attribution", "remote_write",
+           "healthplane", "profiling", "attribution", "goodput",
+           "remote_write",
            "Registry", "REGISTRY", "counter", "gauge",
            "histogram", "render_prometheus", "start_http_server",
            "default_buckets", "set_exemplars", "StepMonitor",
@@ -157,7 +173,7 @@ __all__ = ["metrics", "xtrace", "trace", "aggregate", "export",
            "FlightRecorder", "HangWatchdog", "NumericGuard",
            "NonFiniteError", "DeviceMemoryMonitor", "HealthPlane",
            "DiagCollector", "ContinuousProfiler", "StepAttribution",
-           "set_enabled", "enabled"]
+           "GoodputLedger", "set_enabled", "enabled"]
 
 
 def set_enabled(on):

@@ -141,7 +141,39 @@ def merge_snapshots(snaps, merged_rank="all"):
                         fam_snap.get("name"), exc)
     if merged_rank is not None:
         _merge_histogram_totals(fleet, snaps, str(merged_rank))
+        _merge_counter_totals(fleet, snaps, str(merged_rank))
     return fleet
+
+
+def _merge_counter_totals(fleet, snaps, merged_rank):
+    """The counter analog of the histogram ``sum without (rank)`` pass:
+    per-rank counter children are summed into one extra
+    ``rank=<merged_rank>`` series per label set, so fleet totals (pod
+    goodput seconds, pod shed counts) read as ONE series instead of a
+    client-side sum over N ranks. Gauges are deliberately skipped —
+    summing them is only meaningful per family, not in general."""
+    totals = {}          # (name, labels, values) -> [help, total]
+    for rank in sorted(snaps):
+        for fam_snap in snaps[rank].get("counters", ()):
+            labels = tuple(fam_snap["labels"])
+            for values, value in fam_snap["children"]:
+                key = (fam_snap["name"], labels, tuple(values))
+                acc = totals.get(key)
+                if acc is None:
+                    totals[key] = [fam_snap["help"], value]
+                else:
+                    acc[1] += value
+    for (name, labels, values), (help_, total) in totals.items():
+        rlabel = _rank_label(labels)
+        try:
+            family = fleet.counter(name, help_, labels + (rlabel,))
+        except ValueError:
+            continue    # incompatible redeclaration, warned above
+        labelvalues = dict(zip(labels, values))
+        labelvalues[rlabel] = merged_rank
+        child = family.labels(**labelvalues)
+        with child._lock:
+            child._value = total
 
 
 def _merge_histogram_totals(fleet, snaps, merged_rank):

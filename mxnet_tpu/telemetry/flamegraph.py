@@ -33,7 +33,8 @@ from . import metrics as _metrics
 from . import trace as _trace
 
 __all__ = ["top", "render_top", "collapsed", "dump_collapsed",
-           "diff_top", "render_diff", "frame_label", "render_collapsed"]
+           "diff_top", "render_diff", "frame_label", "render_collapsed",
+           "trace_exemplars"]
 
 # Clock-granularity slack when deciding whether one span nests inside
 # another (µs; perf_counter is ns-resolution but float µs rounding can
@@ -208,6 +209,28 @@ def _strip_loc(name):
 
 def _has_loc(leaf):
     return any(_LOC_RE.search(name) for name in leaf)
+
+
+def trace_exemplars(folded):
+    """Split the ``trace:<id>`` leaf markers (the continuous profiler
+    tags onto threads holding a sampled TraceContext) out of a folded
+    capture. Returns ``(clean_folded, exemplars)``: ``clean_folded``
+    has the marker leaves stripped so the real hot frame is the leaf
+    again, and ``exemplars`` maps each such frame to its
+    ``{trace_id: self_us}`` evidence — a hot frame in a profile links
+    to concrete traces in the merged timeline."""
+    clean = {}
+    exemplars = {}
+    for path, us in folded.items():
+        head, _, leaf = path.rpartition(";")
+        if head and leaf.startswith("trace:"):
+            trace_id = leaf[len("trace:"):]
+            frame = head.rsplit(";", 1)[-1]
+            by_id = exemplars.setdefault(frame, {})
+            by_id[trace_id] = by_id.get(trace_id, 0.0) + us
+            path = head
+        clean[path] = clean.get(path, 0.0) + us
+    return clean, exemplars
 
 
 def _by_leaf(folded, strip_loc=False):

@@ -35,6 +35,9 @@ module closes that loop with three pieces:
                                default — pipe straight into flamegraph.pl)
   ``GET /debug/attribution``   step-phase decomposition, bound cause and
                                per-site executable flops
+  ``GET /debug/goodput``       the goodput ledger: per-category
+                               goodput/badput seconds, closure check,
+                               restart-replay accounting
   ``POST /debug/bundle``       trigger a local flight-recorder bundle NOW
   ``POST /debug/xprof``        capture ``?seconds=N`` of device profile via
                                ``jax.profiler.trace`` into a bundle-linked
@@ -165,19 +168,24 @@ class HealthPlane:
         (default: the process's active profiler; 404 when none runs).
     attribution : StepAttribution, optional — backs
         ``/debug/attribution`` (404 without one).
+    goodput : GoodputLedger, optional — backs ``/debug/goodput``
+        (default: the process's active ledger; 404 when neither
+        exists).
     xprof_dir : capture root for ``POST /debug/xprof`` (default: the
         ``MXNET_XPROF_DIR`` knob, else ``<recorder.directory>/xprof``
         so captures land next to the bundles that reference them).
     """
 
     def __init__(self, watchdog=None, recorder=None, pipelines=(),
-                 profiler=None, attribution=None, xprof_dir=None):
+                 profiler=None, attribution=None, goodput=None,
+                 xprof_dir=None):
         self._watchdog = watchdog if watchdog is not None \
             else _watchdog.HangWatchdog()
         self._recorder = recorder
         self._pipelines = list(pipelines)
         self._profiler = profiler
         self._attribution = attribution
+        self._goodput = goodput
         self._xprof_dir = xprof_dir
         self._xprof_lock = threading.Lock()
         self._xprof_seq = 0
@@ -269,6 +277,20 @@ class HealthPlane:
             return 404, {"error": "no StepAttribution attached"}
         return 200, self._attribution.snapshot()
 
+    def goodput_state(self):
+        """``/debug/goodput`` body: the attached ledger's snapshot
+        (default: the process's active ledger — the same state the
+        durable file and bundle sections render)."""
+        from . import goodput as _goodput
+
+        ledger = self._goodput if self._goodput is not None \
+            else _goodput.active_ledger()
+        if ledger is None:
+            return 404, {"error": "no GoodputLedger attached "
+                                  "(construct one and goodput.install "
+                                  "it)"}
+        return 200, ledger.snapshot()
+
     def xprof(self, seconds=1.0):
         """``POST /debug/xprof`` body: capture ``seconds`` of device
         profile via ``jax.profiler.trace`` into a fresh subdirectory
@@ -352,6 +374,8 @@ class HealthPlane:
                 return self.pprof(seconds=seconds, format=fmt)
             if path == "/debug/attribution":
                 return self.attribution_state()
+            if path == "/debug/goodput":
+                return self.goodput_state()
         elif method == "POST":
             if path == "/debug/bundle":
                 if self._recorder is None:

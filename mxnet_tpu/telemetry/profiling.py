@@ -436,21 +436,35 @@ class ContinuousProfiler:
 
     def debug_state(self, seconds=None):
         """JSON-able view for bundles and ``format=json`` pprof reads:
-        config, counters, per-window metadata, and the merged collapsed
-        capture."""
+        config, counters, per-window metadata, the merged collapsed
+        capture, and per-frame trace exemplars — the ``trace:<id>``
+        markers :meth:`sample` leaves on sampled-context threads,
+        attributed to the hot frame they annotated, so a profile frame
+        links to concrete traces in the merged timeline."""
         parts, samples, selected = self._selected(seconds)
         with self._lock:
             meta = [{"seq": w.seq, "start_wall": w.start_wall,
                      "end_wall": w.end_wall, "samples": w.samples,
                      "overhead_s": round(w.overhead_s, 6)}
                     for w in self.windows]
+        merged = merge_collapsed(parts)
+        # The JSON view carries the linkage structurally: the collapsed
+        # capture is cleaned of trace:<id> leaves, which reappear under
+        # "exemplars" attached to the frame they annotated. (The text
+        # endpoints keep the raw markers for merge tooling.)
+        merged, by_frame = _flamegraph.trace_exemplars(merged)
+        exemplars = {
+            frame: [{"trace_id": tid, "self_us": round(us, 1)}
+                    for tid, us in sorted(ids.items(),
+                                          key=lambda kv: -kv[1])]
+            for frame, ids in by_frame.items()}
         return {
             "hz": self.hz, "window_s": self.window_s,
             "retain": self.retain, "windows": meta,
             "captured_samples": samples,
             "selected_windows": [w.seq for w in selected],
-            "collapsed": _flamegraph.render_collapsed(
-                merge_collapsed(parts)),
+            "collapsed": _flamegraph.render_collapsed(merged),
+            "exemplars": exemplars,
         }
 
     # -- lifecycle ------------------------------------------------------------

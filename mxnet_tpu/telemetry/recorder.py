@@ -314,6 +314,7 @@ class FlightRecorder:
             "profile": self._safe("profile", self._profile_state),
             "device_memory": self._safe("device_memory",
                                         self._memory_state),
+            "goodput": self._safe("goodput", self._goodput_state),
             "compile": self._safe("compile", self._compile_state),
             "env": self._safe("env", self._env_state),
         }
@@ -378,6 +379,16 @@ class FlightRecorder:
         from . import memstats as _memstats
 
         return _memstats.compile_stats()
+
+    @staticmethod
+    def _goodput_state():
+        """The active goodput ledger's snapshot — bundles carry the
+        same numbers ``/debug/goodput`` and the durable ledger file
+        render. None when no ledger is installed."""
+        from . import goodput as _goodput
+
+        ledger = _goodput.active_ledger()
+        return None if ledger is None else ledger.snapshot()
 
     def _env_state(self):
         import platform

@@ -1,0 +1,64 @@
+"""Worker program for the 2-process fleet goodput acceptance test
+(tests/test_goodput.py, launched via tools/launch.py roles — the
+telemetry_dist_prog pattern).
+
+Each rank runs a direct-mode GoodputLedger against the process-global
+registry (so `mx_goodput_seconds_total{category}` is published), books
+rank-distinct badput, and pushes snapshots through the dist kvstore's
+telemetry channel. Rank 0 writes:
+
+* ``scrape.txt``  — the merged exposition: per-rank goodput series AND
+  the summed ``rank="all"`` series the counter merge adds.
+* ``fleet.json``  — ``goodput.fleet_snapshot(aggregator.fleet)``: the
+  pod-level categories/ratio the test cross-checks against the ranks'
+  own committed ledger files.
+
+Every rank also commits its durable ``goodput.rank<R>.json`` into the
+shared directory, so the test can verify the fleet view and the ledger
+files tell the same story.
+"""
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import mxnet_tpu as mx                                 # noqa: E402
+from mxnet_tpu.telemetry import aggregate, goodput     # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    kv = mx.kvstore.create("dist_sync")
+    rank = kv.rank
+
+    ledger = goodput.GoodputLedger(directory=out_dir, rank=rank,
+                                   interval_s=0.0)
+    aggregator = aggregate.Aggregator(kv, interval_s=0.0)
+
+    for i in range(5):
+        time.sleep(0.002)
+        ledger.observe_step(i, seconds=0.1)  # booked, not slept: exact
+    # rank-distinct badput so per-rank series are tellable-apart
+    ledger.book("compile" if rank == 0 else "input_stall",
+                0.5 * (rank + 1))
+    ledger.commit()                          # durable + publishes
+    aggregator.step()                        # final push
+    kv._barrier()                            # peers' pushes have landed
+
+    if rank == 0:
+        aggregator.step()                    # fold the landed pushes
+        fleet = goodput.fleet_snapshot(aggregator.fleet)
+        with open(os.path.join(out_dir, "scrape.txt"), "w") as f:
+            f.write(aggregator.render_prometheus())
+        with open(os.path.join(out_dir, "fleet.json"), "w") as f:
+            json.dump(fleet, f)
+    ledger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

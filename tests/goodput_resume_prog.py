@@ -1,0 +1,74 @@
+"""Worker program for the goodput restart-replay acceptance test
+(tests/test_goodput.py; the data_resume_prog SIGKILL-harness pattern).
+
+One rank runs a direct-mode step loop with a per-step-committing
+GoodputLedger and a sparser CheckpointManager cadence. Modes:
+
+* ``kill``   — checkpoint every ``--ckpt-every`` steps, tick the ledger
+  every step (interval 0 => durable commit per step), then SIGKILL
+  itself after ``--kill-after`` steps (no cleanup, like a preemption).
+* ``resume`` — restore the newest checkpoint, resume the ledger from
+  the restore step, run to ``--steps``, and write ``result.json`` with
+  the final snapshot. The steps between the checkpoint-restore step and
+  the dead run's last committed ledger step re-run as
+  ``restart_replay`` badput — the test asserts that count matches the
+  true gap within one step (the kill step's own commit may or may not
+  have landed).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from mxnet_tpu.checkpoint import CheckpointManager     # noqa: E402
+from mxnet_tpu.telemetry import goodput                # noqa: E402
+from mxnet_tpu.telemetry import metrics as tm          # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--mode", choices=("kill", "resume"), required=True)
+    ap.add_argument("--steps", type=int, default=14)
+    ap.add_argument("--kill-after", type=int, default=8)
+    ap.add_argument("--ckpt-every", type=int, default=3)
+    args = ap.parse_args()
+
+    ckpt_dir = os.path.join(args.dir, "ckpt")
+    ledger = goodput.GoodputLedger(directory=args.dir, rank=0,
+                                   interval_s=0.0,
+                                   registry=tm.Registry())
+    mgr = CheckpointManager(ckpt_dir)
+
+    start = 0
+    if args.mode == "resume":
+        restored = mgr.restore()
+        assert restored is not None, "no checkpoint to resume from"
+        step, _state = restored
+        start = int(step)
+        ledger.resume_from(start)
+
+    for i in range(start, args.steps):
+        time.sleep(0.005)
+        ledger.observe_step(i, seconds=0.005)
+        ledger.tick(step=i)                  # interval 0: commits now
+        if args.mode == "kill":
+            if (i + 1) % args.ckpt_every == 0:
+                mgr.save(i, {"w": [i]}, sync=True)
+            if i + 1 >= args.kill_after:
+                os.kill(os.getpid(), 9)      # preemption, no cleanup
+
+    snap = ledger.snapshot(serving=False)
+    with open(os.path.join(args.dir, "result.json"), "w") as f:
+        json.dump(snap, f)
+    mgr.close()
+    ledger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -918,6 +918,97 @@ def test_render_json_sorted(tmp_path):
     json.loads(render_json(res))  # valid JSON
 
 
+# ---------------------------------------------------------------------------
+# stale-suppression
+# ---------------------------------------------------------------------------
+
+class TestStaleSuppression:
+    def _lint(self, tmp_path, source):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(source))
+        return run_suite([str(p)], checks=["stale-suppression"],
+                         root=str(tmp_path))
+
+    def test_dead_symbol_fires(self, tmp_path):
+        res = self._lint(tmp_path, """
+            f = open("x", "wb")  # mxlint: disable=atomic-write -- safe: GhostWriter re-frames on read
+            """)
+        assert checks_of(res) == ["stale-suppression"]
+        assert "GhostWriter" in res.findings[0].message
+        assert res.findings[0].line == 2
+
+    def test_live_symbol_quiet(self, tmp_path):
+        res = self._lint(tmp_path, """
+            class FrameWriter:
+                pass
+            f = open("x", "wb")  # mxlint: disable=atomic-write -- safe: FrameWriter re-frames on read
+            """)
+        assert res.findings == []
+
+    def test_prose_only_justification_quiet(self, tmp_path):
+        # No concrete references => nothing to audit. This rule grades
+        # reference freshness, not writing style.
+        res = self._lint(tmp_path, """
+            f = open("x", "wb")  # mxlint: disable=atomic-write -- a barrier blocks by definition
+            """)
+        assert res.findings == []
+
+    def test_dead_file_path_fires(self, tmp_path):
+        res = self._lint(tmp_path, """
+            f = open("x", "wb")  # mxlint: disable=atomic-write -- tools/vanished_helper.py tails this
+            """)
+        assert checks_of(res) == ["stale-suppression"]
+        assert "tools/vanished_helper.py" in res.findings[0].message
+
+    def test_live_file_path_quiet(self, tmp_path):
+        tools = tmp_path / "tools"
+        tools.mkdir()
+        (tools / "tailer.py").write_text("pass\n")
+        res = self._lint(tmp_path, """
+            f = open("x", "wb")  # mxlint: disable=atomic-write -- tools/tailer.py tails this
+            """)
+        assert res.findings == []
+
+    def test_continuation_comment_lines_are_part_of_the_why(self, tmp_path):
+        # The justification spans comment-only follow-on lines (that's
+        # how multi-line whys are written in-tree); a live reference on
+        # a continuation line keeps the suppression fresh.
+        res = self._lint(tmp_path, """
+            def framed_append():
+                pass
+            # mxlint: disable=atomic-write -- incremental append is
+            # the API: framed_append() recovers torn tails on read
+            f = open("x", "wb")
+            """)
+        assert res.findings == []
+
+    def test_one_live_reference_keeps_it_alive(self, tmp_path):
+        # none-resolve rule: prose words that merely look like symbols
+        # must not flag a justification that still cites something real.
+        res = self._lint(tmp_path, """
+            class FrameWriter:
+                pass
+            f = open("x", "wb")  # mxlint: disable=atomic-write -- FrameWriter took over from OldGhostPath
+            """)
+        assert res.findings == []
+
+    def test_dead_knob_reference_fires(self, tmp_path):
+        pkg = tmp_path / "mxnet_tpu"
+        pkg.mkdir()
+        (pkg / "env.py").write_text(textwrap.dedent("""
+            from collections import namedtuple
+            Knob = namedtuple("Knob", "name typ default where doc subsumed")
+            CATALOGUE = [
+                Knob("MXNET_LIVE_KNOB", int, 1, "x.py", "a knob", False),
+            ]
+            """))
+        res = self._lint(tmp_path, """
+            f = open("x", "wb")  # mxlint: disable=atomic-write -- MXNET_VANISHED_KNOB gates this path
+            """)
+        assert checks_of(res) == ["stale-suppression"]
+        assert "MXNET_VANISHED_KNOB" in res.findings[0].message
+
+
 def test_tree_is_clean():
     """The tier-1 gate: the full suite over mxnet_tpu/ is ZERO findings.
 

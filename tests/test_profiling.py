@@ -802,6 +802,72 @@ def test_collector_gc_keeps_newest_per_kind(tmp_path):
 
 # -- tools/profile_tool.py ----------------------------------------------------
 
+def test_trace_exemplars_split_markers_from_hot_frames():
+    """ISSUE 20 satellite: ``trace:<id>`` leaf markers become per-frame
+    exemplars — the real hot frame keeps its self time instead of the
+    marker swallowing it as the leaf."""
+    folded = {
+        "main;hot (x.py:1);trace:abc": 500.0,
+        "main;hot (x.py:1);trace:def": 200.0,
+        "main;hot (x.py:1)": 100.0,
+        "main;cold (y.py:2)": 50.0,
+    }
+    clean, exemplars = flamegraph.trace_exemplars(folded)
+    assert clean == {"main;hot (x.py:1)": 800.0,
+                     "main;cold (y.py:2)": 50.0}
+    assert exemplars == {"hot (x.py:1)": {"abc": 500.0, "def": 200.0}}
+    leaf = flamegraph._by_leaf(clean)
+    assert leaf["hot (x.py:1)"] == 800.0
+    assert not any(f.startswith("trace:") for f in leaf)
+
+
+def test_sampled_context_surfaces_as_exemplar_in_debug_state():
+    from mxnet_tpu.telemetry import xtrace
+
+    ctx = xtrace.new_root(sampled=True)
+    stop = threading.Event()
+
+    def traced_loop():
+        with xtrace.activate(ctx):
+            while not stop.is_set():
+                time.sleep(0.001)
+
+    thread = threading.Thread(target=traced_loop,
+                              name="gp_exemplar", daemon=True)
+    thread.start()
+    profiler = telemetry.ContinuousProfiler(hz=200.0, window_s=3600.0)
+    try:
+        time.sleep(0.02)              # the loop is inside activate()
+        for _ in range(10):
+            profiler.sample()
+        state = profiler.debug_state()
+        hits = [frame for frame, ids in state["exemplars"].items()
+                if any(e["trace_id"] == ctx.trace_id for e in ids)]
+        assert hits, state["exemplars"]
+        # the marker is exemplar metadata now, not a collapsed leaf
+        assert "trace:%s" % ctx.trace_id not in state["collapsed"]
+        # and each exemplar row carries its sampled self time
+        for ids in state["exemplars"].values():
+            assert all(e["self_us"] > 0 for e in ids)
+    finally:
+        profiler.close()
+        stop.set()
+        thread.join()
+
+
+def test_profile_tool_top_prints_exemplars(tmp_path, capsys):
+    tool = _tool("profile_tool")
+    cap = tmp_path / "c.collapsed"
+    cap.write_text("main;hot (x.py:1);trace:abc 900\n"
+                   "main;hot (x.py:1);trace:ffe 300\n"
+                   "main;cold (y.py:2) 100\n")
+    assert tool.main(["top", str(cap), "-k", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "hot (x.py:1)" in out
+    assert "exemplars: trace:abc, trace:ffe" in out
+    assert "trace:abc" not in out.splitlines()[2]  # not ranked as frame
+
+
 def test_profile_tool_top_diff_merge(tmp_path, capsys):
     tool = _tool("profile_tool")
     a = tmp_path / "a.collapsed"

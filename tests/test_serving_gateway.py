@@ -357,6 +357,96 @@ def test_unregister_fails_queued_and_drops_series():
         gw.shutdown()
 
 
+# -- drain-aware unregister (ISSUE 20 satellite) -----------------------------
+
+def _counter_total(fam):
+    return sum(c.value for _, c in fam.collect())
+
+
+def test_unregister_drains_queued_work_first():
+    """Queued-and-accepted requests are SERVED before the model leaves;
+    the served count lands on mx_gateway_unregister_drained_total."""
+    def slow_dot(w, x):
+        time.sleep(0.05)
+        return _dot(w, x)
+
+    gw = ModelGateway()
+    try:
+        a = _name("drain")
+        gw.register(ModelSpec(a, fn=slow_dot, params=[_weight()],
+                              item_shape=(4,), max_batch=1))
+        drained0 = _counter_total(gwmod._gw_unreg_drained)
+        shed0 = _counter_total(gwmod._gw_unreg_shed)
+        gw.pause()
+        futs = [gw.submit(a, np.ones((1, 4), np.float32))
+                for _ in range(4)]
+        gw.resume()
+        gw.unregister(a)                 # default timeout: plenty
+        for fut in futs:
+            assert fut.result(timeout=10).output.shape == (1, 3)
+        assert _counter_total(gwmod._gw_unreg_drained) - drained0 >= 2
+        assert _counter_total(gwmod._gw_unreg_shed) == shed0
+        assert a not in gw.models()
+    finally:
+        gw.shutdown()
+
+
+def test_unregister_drain_timeout_sheds_remainder():
+    """A drain bounded by MXNET_GATEWAY_DRAIN_TIMEOUT_S (here the
+    explicit override) strands what it cannot serve in time: those fail
+    ServiceUnavailable and count on mx_gateway_unregister_shed_total —
+    the gateway-badput feed."""
+    class _SleepyBackend:
+        # Plain-Python backend: unlike an fn (traced once into a
+        # CachedOp at warmup, then microseconds per batch), this sleeps
+        # on EVERY call — so the worker is held mid-batch long past the
+        # drain deadline and the rest of the queue is stranded.
+        compile_count = 0
+
+        def __call__(self, batch):
+            time.sleep(1.0)
+            return mx.nd.array(np.ones((batch.shape[0], 3), np.float32))
+
+    gw = ModelGateway()
+    try:
+        a = _name("slowdrain")
+        gw.register(ModelSpec(a, fn=_dot, params=[_weight()],
+                              item_shape=(4,), max_batch=1))
+        gw.swap_backend(a, _SleepyBackend())
+        shed0 = _counter_total(gwmod._gw_unreg_shed)
+        gw.pause()
+        futs = [gw.submit(a, np.ones((1, 4), np.float32))
+                for _ in range(4)]
+        gw.resume()
+        gw.unregister(a, drain_timeout=0.3)
+        outcomes = {"served": 0, "shed": 0}
+        for fut in futs:
+            try:
+                fut.result(timeout=10)
+                outcomes["served"] += 1
+            except ServiceUnavailableError:
+                outcomes["shed"] += 1
+        assert outcomes["shed"] >= 1, outcomes    # timeout stranded some
+        assert _counter_total(gwmod._gw_unreg_shed) - shed0 == \
+            outcomes["shed"]
+        assert a not in gw.models()
+    finally:
+        gw.shutdown()
+
+
+def test_draining_model_rejects_new_admissions():
+    gw = ModelGateway()
+    try:
+        a = _name("gate")
+        gw.register(_spec(a))
+        gw.pause()
+        gw._models[a].draining = True    # what unregister arms first
+        with pytest.raises(ServiceUnavailableError, match="draining"):
+            gw.submit(a, np.ones((1, 4), np.float32))
+    finally:
+        gw.shutdown()
+
+
 # -- quantized bucket ladders ------------------------------------------------
 
 def test_quantized_int8_backend():

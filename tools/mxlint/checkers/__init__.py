@@ -10,6 +10,7 @@ from .locks import LockChecker
 from .retrace import RetraceHazardChecker
 from .signals import SignalChecker
 from .staleknobs import StaleKnobChecker
+from .suppressions import SuppressionAgeChecker
 from .telemetry_names import TelemetryNameChecker
 from .threads import ThreadChecker
 from .trace_propagation import TracePropagationChecker
@@ -22,6 +23,7 @@ ALL_CHECKERS = (
     WriteChecker,
     EnvKnobChecker,
     StaleKnobChecker,
+    SuppressionAgeChecker,
     ThreadChecker,
     TelemetryNameChecker,
     TracePropagationChecker,
@@ -37,6 +39,7 @@ CHECKS = {
     "atomic-write": WriteChecker,
     "env-knob": EnvKnobChecker,
     "stale-knob": StaleKnobChecker,
+    "stale-suppression": SuppressionAgeChecker,
     "thread-lifecycle": ThreadChecker,
     "telemetry-naming": TelemetryNameChecker,
     "trace-propagation": TracePropagationChecker,

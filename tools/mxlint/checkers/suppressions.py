@@ -1,0 +1,228 @@
+"""Checker: stale suppression justifications.
+
+A ``# mxlint: disable=<check> -- <why>`` justification earns its keep
+by citing the concrete thing that makes the risky line safe — a class,
+a helper function, a file that depends on the behaviour. Code moves on;
+the comment doesn't. The failure mode this kills: the justification
+says "safe because FooBar re-frames on read" long after ``FooBar`` was
+deleted, and every reader (and every future lint run) keeps trusting a
+safety argument whose premise no longer exists in the tree.
+
+The checker re-reads each justified suppression (the directive line's
+tail plus the immediately following comment-only lines — that's how
+multi-line justifications are written here), extracts the *concrete*
+references in the prose, and verifies they still resolve:
+
+* file paths (``tools/im2rec.py``) must exist under the repo root;
+* env knobs (``MXNET_FOO``) must still be declared in the catalogue;
+* symbol-like tokens — ``CamelCase`` names, ``called()`` functions,
+  ``snake_case`` identifiers, ``dotted.names`` — must be defined
+  somewhere in the project sources (or be Python builtins / stdlib
+  modules).
+
+Purely-prose justifications ("a barrier blocks by definition") cite
+nothing and are never flagged — this rule audits references, it does
+not grade writing. A justification is flagged when it cites a file
+that is gone, or when it cites symbols and *none* of them resolve
+(one surviving symbol keeps the argument anchored; the none-resolve
+rule keeps prose words that merely look like identifiers from raising
+false alarms).
+
+Findings anchor to the directive line, where the fix lives: update the
+justification to name what the code relies on *today*, or delete the
+suppression and re-earn it.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import re
+import sys
+
+from ..core import Checker, Finding, _SUPPRESS_RE, iter_py_files
+from .staleknobs import SCAN_ROOTS
+
+# Concrete-reference shapes pulled out of justification prose.
+_PATH_RE = re.compile(r"\b[\w./-]*\w\.py\b")
+_CALL_RE = re.compile(r"\b([A-Za-z_]\w*)\(\)")
+_DOTTED_RE = re.compile(r"\b([A-Za-z_]\w+(?:\.[A-Za-z_]\w+)+)\b")
+_CAMEL_RE = re.compile(r"\b([A-Z][A-Za-z0-9]+)\b")
+_SNAKE_RE = re.compile(r"\b([a-z]\w*(?:_\w+)+)\b")
+_KNOB_RE = re.compile(r"\b((?:MXNET|DMLC)_[A-Z0-9_]+)\b")
+
+# CamelCase words that are tech prose, not project symbols.
+_STOPWORDS = frozenset({
+    "CPython", "MicroPython", "PyPy", "Python",
+    "NumPy", "SciPy", "PyTorch", "TensorFlow", "JavaScript",
+    "GitHub", "GitLab", "MacOS", "JSONLines", "ProtoBuf",
+})
+
+
+def _harvest_defined(tree, defined):
+    """Fold every name a module defines into ``defined``: class/def
+    names, assignment targets (incl. ``self.x`` attribute assigns),
+    import aliases."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            defined.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        defined.add(sub.id)
+                    elif isinstance(sub, ast.Attribute):
+                        defined.add(sub.attr)
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name):
+                defined.add(node.target.id)
+            elif isinstance(node.target, ast.Attribute):
+                defined.add(node.target.attr)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                defined.add((alias.asname or alias.name).split(".")[-1])
+
+
+def _camel_tokens(text):
+    """CamelCase identifiers: a lowercase run AND a second uppercase
+    hump ("MXRecordIO" yes; "Timer"/"THIS"/"RPC" no)."""
+    out = []
+    for tok in _CAMEL_RE.findall(text):
+        if tok in _STOPWORDS:
+            continue
+        if any(c.islower() for c in tok) and \
+                any(c.isupper() for c in tok[1:]):
+            out.append(tok)
+    return out
+
+
+class SuppressionAgeChecker(Checker):
+    name = "stale-suppression"
+    description = ("suppression justifications still reference "
+                   "files/symbols that exist in the tree")
+
+    def begin_project(self, ctx):
+        self._ctx = ctx
+        self._entries = []       # (relpath, line, checks, justification)
+        self._run_files = set()  # modules of THIS run (may sit outside
+        self._run_defined = set()   # SCAN_ROOTS, e.g. fixture trees)
+
+    def check_module(self, mod):
+        self._run_files.add(mod.relpath)
+        _harvest_defined(mod.tree, self._run_defined)
+        # ModuleInfo keeps only {line: (checks, justified)} — the
+        # justification text is not retained — so re-scan the raw
+        # lines with the grammar regex and fold in the comment-only
+        # continuation lines that multi-line justifications use.
+        for i, raw in enumerate(mod.lines, 1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m or not m.group(2):
+                continue
+            parts = [m.group(2)]
+            j = i
+            while j < len(mod.lines):
+                nxt = mod.lines[j].strip()
+                if not nxt.startswith("#") or _SUPPRESS_RE.search(nxt):
+                    break
+                parts.append(nxt.lstrip("#").strip())
+                j += 1
+            self._entries.append(
+                (mod.relpath, i, m.group(1), " ".join(parts)))
+        return ()
+
+    # -- existence universe ------------------------------------------
+
+    def _build_universe(self):
+        """One pass over the project roots: every file relpath plus
+        every defined name (class/def, assignment targets, attribute
+        assigns, module basenames)."""
+        files = set()
+        defined = set(dir(builtins))
+        roots = [os.path.join(self._ctx.root, r) for r in SCAN_ROOTS]
+        roots = [r for r in roots if os.path.exists(r)]
+        for root in roots:
+            if os.path.isfile(root):
+                files.add(os.path.relpath(root, self._ctx.root)
+                          .replace(os.sep, "/"))
+                continue
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames
+                               if not d.startswith(".")
+                               and d != "__pycache__"]
+                for fn in filenames:
+                    files.add(os.path.relpath(
+                        os.path.join(dirpath, fn),
+                        self._ctx.root).replace(os.sep, "/"))
+        for path in iter_py_files(roots):
+            defined.add(os.path.splitext(os.path.basename(path))[0])
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError, ValueError):
+                continue
+            _harvest_defined(tree, defined)
+        files |= self._run_files
+        defined |= self._run_defined
+        for rel in self._run_files:
+            defined.add(os.path.splitext(os.path.basename(rel))[0])
+        return files, defined
+
+    def _path_exists(self, token, files):
+        token = token.lstrip("./")
+        if os.path.exists(os.path.join(self._ctx.root, token)):
+            return True
+        return any(f == token or f.endswith("/" + token) for f in files)
+
+    # -- verdicts ----------------------------------------------------
+
+    def finalize(self):
+        if not self._entries:
+            return ()
+        files, defined = self._build_universe()
+        stdlib = getattr(sys, "stdlib_module_names", ())
+        findings = []
+        for rel, line, checks, text in self._entries:
+            paths = set(_PATH_RE.findall(text))
+            dead_paths = sorted(p for p in paths
+                                if not self._path_exists(p, files))
+            symbols = set()
+            for tok in _KNOB_RE.findall(text):
+                symbols.add(tok)
+            for tok in _CALL_RE.findall(text):
+                symbols.add(tok)
+            symbols.update(_camel_tokens(text))
+            for tok in _SNAKE_RE.findall(text):
+                symbols.add(tok)
+            for tok in _DOTTED_RE.findall(text):
+                if not tok.endswith(".py"):
+                    symbols.add(tok)
+
+            def resolves(tok):
+                if _KNOB_RE.fullmatch(tok):
+                    return tok in self._ctx.catalogue
+                if "." in tok:
+                    head, _, last = tok.partition(".")
+                    return (tok.rsplit(".", 1)[-1] in defined
+                            or head in stdlib)
+                return tok in defined
+
+            live = sorted(t for t in symbols if resolves(t))
+            dead = sorted(t for t in symbols if not resolves(t))
+            if dead_paths:
+                findings.append(Finding(
+                    rel, line, self.name,
+                    "suppression justification for %r cites %s — no "
+                    "longer in the tree; update the justification to "
+                    "what the code relies on today (or drop the "
+                    "suppression and re-earn it)"
+                    % (checks, ", ".join(dead_paths))))
+            elif dead and not live:
+                findings.append(Finding(
+                    rel, line, self.name,
+                    "suppression justification for %r references %s — "
+                    "none of these symbols exist in the tree anymore; "
+                    "the safety argument's premise is gone, rewrite it "
+                    "against today's code (or drop the suppression)"
+                    % (checks, ", ".join(dead))))
+        return findings

@@ -38,6 +38,10 @@ def cmd_top(args):
     from mxnet_tpu.telemetry import flamegraph
 
     folded = flamegraph._parse_collapsed(_read(args.capture))
+    # trace:<id> leaf markers become per-frame exemplars: the real hot
+    # frame keeps its self time, and its row links to the concrete
+    # traces the sampler caught it inside.
+    folded, exemplars = flamegraph.trace_exemplars(folded)
     leaf = flamegraph._by_leaf(folded)
     total = sum(leaf.values()) or 1.0
     rows = sorted(leaf.items(), key=lambda kv: kv[1], reverse=True)
@@ -47,6 +51,11 @@ def cmd_top(args):
     for name, us in rows[:args.k]:
         print("%-64s %12.3f %6.1f%%" % (name, us / 1e3,
                                         us / total * 100.0))
+        ids = exemplars.get(name)
+        if ids:
+            ranked = sorted(ids.items(), key=lambda kv: -kv[1])
+            print("    exemplars: %s" % ", ".join(
+                "trace:%s" % tid for tid, _ in ranked[:3]))
     if not rows:
         print("(empty capture)")
     return 0
